@@ -1,0 +1,122 @@
+"""Host BN254 math correctness: group laws, twist, pairing bilinearity."""
+import pytest
+
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+
+
+def test_curve_constants():
+    # p and r are BN primes derived from u
+    u = hm.U
+    assert hm.P == 36 * u**4 + 36 * u**3 + 24 * u**2 + 6 * u + 1
+    assert hm.R == 36 * u**4 + 36 * u**3 + 18 * u**2 + 6 * u + 1
+    assert hm.g1_is_on_curve(hm.G1_GEN)
+    assert hm.g2_is_on_curve(hm.G2_GEN)
+
+
+def test_g1_group_law(rng):
+    g = hm.G1_GEN
+    assert hm.g1_mul(g, hm.R) is None  # order r
+    a, b = hm.rand_zr(rng), hm.rand_zr(rng)
+    left = hm.g1_mul(g, (a + b) % hm.R)
+    right = hm.g1_add(hm.g1_mul(g, a), hm.g1_mul(g, b))
+    assert left == right
+    assert hm.g1_add(left, hm.g1_neg(left)) is None
+
+
+def test_g2_group_law(rng):
+    q = hm.G2_GEN
+    assert hm.g2_mul(q, hm.R) is None  # subgroup order r
+    a, b = hm.rand_zr(rng), hm.rand_zr(rng)
+    assert hm.g2_mul(q, (a + b) % hm.R) == hm.g2_add(hm.g2_mul(q, a), hm.g2_mul(q, b))
+
+
+def test_fp2_fp12_field(rng):
+    a = hm.fp2(rng.randrange(hm.P), rng.randrange(hm.P))
+    assert hm.fp2_mul(a, hm.fp2_inv(a)) == hm.FP2_ONE
+    x = tuple(hm.fp2(rng.randrange(hm.P), rng.randrange(hm.P)) for _ in range(6))
+    assert hm.fp12_mul(x, hm.fp12_inv(x)) == hm.FP12_ONE
+    # frobenius is the p-power map
+    assert hm.fp12_frobenius(x) == hm.fp12_pow(x, hm.P)
+
+
+@pytest.mark.slow
+def test_pairing_bilinear():
+    p, q = hm.G1_GEN, hm.G2_GEN
+    e = hm.pairing(p, q)
+    assert e != hm.FP12_ONE  # non-degenerate
+    assert hm.fp12_pow(e, hm.R) == hm.FP12_ONE  # in the r-torsion of GT
+    a, b = 17, 29
+    e_ab = hm.pairing(hm.g1_mul(p, a), hm.g2_mul(q, b))
+    assert e_ab == hm.fp12_pow(e, a * b)
+
+
+@pytest.mark.slow
+def test_pairing_product_unity():
+    # e(aP, Q) * e(-P, aQ) == 1
+    a = 123456789
+    one = hm.pairing_product(
+        [
+            (hm.g1_mul(hm.G1_GEN, a), hm.G2_GEN),
+            (hm.g1_neg(hm.G1_GEN), hm.g2_mul(hm.G2_GEN, a)),
+        ]
+    )
+    assert hm.gt_is_unity(one)
+
+
+def test_encodings_roundtrip(rng):
+    pt = hm.rand_g1(rng)
+    assert hm.g1_from_bytes(hm.g1_to_bytes(pt)) == pt
+    assert hm.g1_from_bytes(hm.g1_to_bytes(None)) is None
+    q = hm.rand_g2(rng)
+    assert hm.g2_from_bytes(hm.g2_to_bytes(q)) == q
+    z = hm.rand_zr(rng)
+    assert hm.zr_from_bytes(hm.zr_to_bytes(z)) == z
+
+
+def test_hash_to_zr_and_g1():
+    z1 = hm.hash_to_zr(b"hello")
+    z2 = hm.hash_to_zr(b"hello")
+    assert z1 == z2 and 0 <= z1 < hm.R
+    assert hm.hash_to_zr(b"world") != z1
+    pt = hm.hash_to_g1(b"hello")
+    assert hm.g1_is_on_curve(pt)
+    assert pt == hm.hash_to_g1(b"hello")
+
+
+def test_noncanonical_encodings_rejected(rng):
+    pt = hm.rand_g1()
+    raw = bytearray(hm.g1_to_bytes(pt))
+    # coordinate >= P
+    big = bytearray(b"\x00" + ((pt[0] + hm.P).to_bytes(32, "big")) + pt[1].to_bytes(32, "big"))
+    with pytest.raises(ValueError):
+        hm.g1_from_bytes(bytes(big))
+    # bad tag
+    raw[0] = 7
+    with pytest.raises(ValueError):
+        hm.g1_from_bytes(bytes(raw))
+    # non-canonical infinity
+    with pytest.raises(ValueError):
+        hm.g1_from_bytes(b"\x01" + b"\x00" * 63 + b"\x02")
+    with pytest.raises(ValueError):
+        hm.g1_from_bytes(b"\x00" * 10)
+
+
+def test_g2_subgroup_check(rng):
+    # random on-curve twist point is (w.h.p.) outside the r-subgroup
+    while True:
+        x = (rng.randrange(hm.P), rng.randrange(hm.P))
+        y = hm.fp2_sqrt(hm.fp2_add(hm.fp2_mul(hm.fp2_sqr(x), x), hm.B2))
+        if y is not None:
+            pt = (x, y)
+            break
+    assert hm.g2_is_on_curve(pt)
+    assert not hm.g2_in_subgroup(pt)
+    with pytest.raises(ValueError):
+        hm.g2_from_bytes(hm.g2_to_bytes(pt))
+
+
+def test_multiexp_length_mismatch(rng):
+    with pytest.raises(ValueError):
+        hm.g1_multiexp([hm.G1_GEN], [1, 2])
+    with pytest.raises(ValueError):
+        hm.g2_multiexp([hm.G2_GEN, hm.G2_GEN], [1])
