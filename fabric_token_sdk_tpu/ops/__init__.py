@@ -1,0 +1,20 @@
+"""TPU compute path: limb-tensor bigint, prime fields, curves, pairing.
+
+Design (TPU-first, not a port — reference delegates to gnark's x86-64
+assembly; we target the VPU/MXU instead):
+
+* Field elements are tensors of 32 radix-2^8 limbs in ``int32``
+  (little-endian limb order), batched over leading axes. 8-bit limbs keep
+  every partial product and column sum inside int32 — no 64-bit emulation —
+  and map onto TPU-native integer lanes.
+* Multiplication is Montgomery (REDC, R = 2^256) built from branch-free
+  column convolutions; carries use signed arithmetic-shift passes under
+  ``lax.while_loop``.
+* Group ops are batched Jacobian formulas with select-based (branch-free)
+  edge handling; scalar multiplication is a ``lax.scan`` over bits.
+* Hot multiexps use fixed-base window tables contracted with one-hot digit
+  matrices — dense matmuls that ride the MXU.
+"""
+
+from . import limbs  # noqa: F401
+from .field import FP, FR, FieldSpec  # noqa: F401

@@ -1,0 +1,187 @@
+"""Batched Montgomery prime-field arithmetic on limb tensors.
+
+Reference counterpart: IBM mathlib's Zr/Fp scalar ops (used throughout
+token/core/zkatdlog/crypto). Here a field is a `FieldSpec` of baked numpy
+limb constants; every op is branch-free, batched over leading axes, and
+jit-safe. Elements live in Montgomery form (x·R mod p, R = 2^256) as
+(..., 32) int32 limb tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import limbs as lb
+from ..crypto import hostmath as hm
+
+
+def _opjit(fn=None, *, static=()):
+    """jit a FieldSpec method with `self` static (specs are singletons)."""
+
+    def wrap(f):
+        return jax.jit(f, static_argnums=(0,) + tuple(static))
+
+    return wrap(fn) if fn is not None else wrap
+
+
+@dataclass(frozen=True, eq=False)
+class FieldSpec:
+    """A prime field with Montgomery constants baked as limb arrays."""
+
+    name: str
+    modulus: int
+    nlimbs: int = lb.NLIMBS
+    p_limbs: np.ndarray = field(init=False, repr=False)
+    pprime_limbs: np.ndarray = field(init=False, repr=False)  # -p^-1 mod R
+    r2_limbs: np.ndarray = field(init=False, repr=False)  # R^2 mod p
+    one_mont: np.ndarray = field(init=False, repr=False)  # R mod p
+
+    def __post_init__(self):
+        R = 1 << (lb.RADIX_BITS * self.nlimbs)
+        if self.modulus >= R or self.modulus % 2 == 0:
+            raise ValueError("modulus must be odd and fit the limb width")
+        object.__setattr__(self, "p_limbs", lb.int_to_limbs(self.modulus, self.nlimbs))
+        pprime = (-pow(self.modulus, -1, R)) % R
+        object.__setattr__(self, "pprime_limbs", lb.int_to_limbs(pprime, self.nlimbs))
+        object.__setattr__(self, "r2_limbs", lb.int_to_limbs(R * R % self.modulus, self.nlimbs))
+        object.__setattr__(self, "one_mont", lb.int_to_limbs(R % self.modulus, self.nlimbs))
+
+    # ------------------------------------------------------------- reduce
+
+    @_opjit
+    def cond_sub_p(self, x):
+        """x in [0, 2p) -> x mod p."""
+        ge = lb.compare_ge(x, self.p_limbs)
+        d = jnp.where(ge[..., None], x - self.p_limbs, x)
+        return lb.normalize(d)
+
+    # ------------------------------------------------------------- ring ops
+
+    @_opjit
+    def add(self, x, y):
+        return self.cond_sub_p(lb.normalize(x + y))
+
+    @_opjit
+    def sub(self, x, y):
+        return self.cond_sub_p(lb.normalize(x + self.p_limbs - y))
+
+    @_opjit
+    def neg(self, x):
+        return self.cond_sub_p(lb.normalize(self.p_limbs - x + jnp.zeros_like(x)))
+
+    @_opjit
+    def mul(self, x, y):
+        """Montgomery product: REDC(x*y)."""
+        n = self.nlimbs
+        t = lb.mul_full(x, y)  # (..., 2n+1)
+        m = lb.mul_low(t[..., :n], self.pprime_limbs, keep=n)
+        mp = lb.mul_full(m, self.p_limbs)  # (..., 2n+1)
+        width = 2 * n + 2
+        acc = jnp.zeros(t.shape[:-1] + (width,), dtype=jnp.int32)
+        acc = acc.at[..., : 2 * n + 1].add(t)
+        acc = acc.at[..., : 2 * n + 1].add(mp)
+        res = lb.normalize(acc)[..., n : 2 * n]
+        return self.cond_sub_p(res)
+
+    @_opjit
+    def sqr(self, x):
+        return self.mul(x, x)
+
+    @_opjit(static=(2,))
+    def pow_const(self, x, e: int):
+        """x^e for a python-int exponent, via scan over its bits (MSB first)."""
+        if e == 0:
+            return jnp.broadcast_to(jnp.asarray(self.one_mont), x.shape).astype(jnp.int32)
+        bits = np.array([int(b) for b in bin(e)[2:]], dtype=np.int32)
+
+        def step(acc, bit):
+            acc = self.mul(acc, acc)
+            acc = jnp.where(bit > 0, self.mul(acc, x), acc)
+            return acc, None
+
+        init = jnp.broadcast_to(jnp.asarray(self.one_mont), x.shape).astype(jnp.int32)
+        out, _ = lax.scan(step, init, jnp.asarray(bits))
+        return out
+
+    @_opjit
+    def inv(self, x):
+        """Montgomery inverse by Fermat: x^(p-2). x must be nonzero."""
+        return self.pow_const(x, self.modulus - 2)
+
+    @_opjit(static=(2,))
+    def mul_small(self, x, k: int):
+        """x * k for small non-negative python int k (k < 2^15)."""
+        return self.cond_sub_p_loop(lb.normalize(x * jnp.int32(k)))
+
+    def cond_sub_p_loop(self, x):
+        """x in [0, k*p) for small k -> x mod p (repeated conditional subtract)."""
+
+        def cond(v):
+            return jnp.any(lb.compare_ge(v, self.p_limbs))
+
+        def body(v):
+            return self.cond_sub_p(v)
+
+        return lax.while_loop(cond, body, x)
+
+    # ------------------------------------------------------------- domain
+
+    @_opjit
+    def to_mont(self, x):
+        return self.mul(x, jnp.asarray(self.r2_limbs))
+
+    @_opjit
+    def from_mont(self, x):
+        one = jnp.zeros_like(x).at[..., 0].set(1)
+        return self.mul(x, one)
+
+    # ------------------------------------------------------------- host I/O
+
+    def encode(self, values) -> jnp.ndarray:
+        """Host ints -> Montgomery limb tensor (N, nlimbs)."""
+        vals = [v % self.modulus for v in values]
+        raw = lb.ints_to_limbs(vals, self.nlimbs)
+        return self.to_mont(jnp.asarray(raw))
+
+    def encode_scalar(self, v: int) -> jnp.ndarray:
+        return self.encode([v])[0]
+
+    def decode(self, x) -> list:
+        """Montgomery limb tensor -> host ints."""
+        return lb.batch_limbs_to_ints(np.asarray(self.from_mont(x)))
+
+    def decode_scalar(self, x) -> int:
+        return self.decode(x[None, ...])[0]
+
+    # ------------------------------------------------------------- misc
+
+    def zeros(self, shape=()) -> jnp.ndarray:
+        return jnp.zeros(tuple(shape) + (self.nlimbs,), dtype=jnp.int32)
+
+    def ones_mont(self, shape=()) -> jnp.ndarray:
+        return jnp.broadcast_to(
+            jnp.asarray(self.one_mont), tuple(shape) + (self.nlimbs,)
+        ).astype(jnp.int32)
+
+    def is_zero(self, x):
+        return lb.is_zero(x)
+
+    def eq(self, x, y):
+        return jnp.all(x == y, axis=-1)
+
+
+@functools.lru_cache(maxsize=None)
+def _specs():
+    return (
+        FieldSpec("bn254_fp", hm.P),
+        FieldSpec("bn254_fr", hm.R),
+    )
+
+
+FP, FR = _specs()

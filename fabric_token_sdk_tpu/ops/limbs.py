@@ -1,0 +1,142 @@
+"""Radix-2^8 limb-vector arithmetic in int32 tensors.
+
+A k-bit integer is a little-endian vector of 8-bit limbs stored as int32.
+All intermediates are engineered to stay inside int32:
+
+* 8x8-bit partial products are < 2^16,
+* a product column accumulates at most 2*NLIMBS-1 = 63 of them plus a
+  carried-in limb: < 2^23,
+* carry normalization uses arithmetic shifts (floor semantics), so signed
+  intermediates from subtraction are handled exactly — provided the TOTAL
+  value is non-negative (callers add a modulus before subtracting).
+
+These helpers are modulus-agnostic; ``field.py`` builds Montgomery fields
+on top.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RADIX_BITS = 8
+RADIX = 1 << RADIX_BITS
+MASK = RADIX - 1
+NLIMBS = 32  # 256-bit elements
+
+
+# ---------------------------------------------------------------- host conv
+
+def int_to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Host: python int -> little-endian limb vector."""
+    if x < 0:
+        raise ValueError("int_to_limbs: negative value")
+    out = np.zeros(nlimbs, dtype=np.int32)
+    for i in range(nlimbs):
+        out[i] = x & MASK
+        x >>= RADIX_BITS
+    if x:
+        raise ValueError("int_to_limbs: value does not fit")
+    return out
+
+
+def limbs_to_int(v) -> int:
+    """Host: limb vector (canonical or not) -> python int."""
+    arr = np.asarray(v).astype(object)
+    return int(sum(int(arr[..., i]) << (RADIX_BITS * i) for i in range(arr.shape[-1])))
+
+
+def ints_to_limbs(xs, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Host: iterable of ints -> (N, nlimbs) array."""
+    return np.stack([int_to_limbs(x, nlimbs) for x in xs])
+
+
+def batch_limbs_to_ints(arr) -> list:
+    a = np.asarray(arr)
+    flat = a.reshape(-1, a.shape[-1])
+    return [limbs_to_int(row) for row in flat]
+
+
+# ---------------------------------------------------------------- carries
+
+def carry_pass(x):
+    """One carry-propagation pass (signed, floor-shift semantics)."""
+    c = x >> RADIX_BITS
+    rem = x - (c << RADIX_BITS)
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+    )
+    return rem + shifted
+
+
+def normalize(x):
+    """Propagate carries until every limb is canonical (in [0, RADIX)).
+
+    The represented TOTAL must be non-negative and fit the vector width;
+    otherwise this loops forever (callers: add the modulus before any
+    subtraction, size product accumulators at 2*NLIMBS+1).
+    """
+
+    def cond(v):
+        return jnp.any((v < 0) | (v > MASK))
+
+    return jax.lax.while_loop(cond, carry_pass, x)
+
+
+# ---------------------------------------------------------------- add / cmp
+
+def add(x, y):
+    """Limb-wise add; caller normalizes/reduces."""
+    return x + y
+
+
+def compare_ge(x, y):
+    """Lexicographic >= of two canonical limb vectors. Shapes broadcast."""
+    x, y = jnp.broadcast_arrays(x, y)
+    neq = x != y
+    # index of the most significant differing limb (0 if none differ)
+    msd = x.shape[-1] - 1 - jnp.argmax(neq[..., ::-1], axis=-1)
+    xd = jnp.take_along_axis(x, msd[..., None], axis=-1)[..., 0]
+    yd = jnp.take_along_axis(y, msd[..., None], axis=-1)[..., 0]
+    return jnp.where(jnp.any(neq, axis=-1), xd >= yd, True)
+
+
+def is_zero(x):
+    return jnp.all(x == 0, axis=-1)
+
+
+# ---------------------------------------------------------------- multiply
+
+@functools.lru_cache(maxsize=None)
+def _conv_matrix(nx: int, ny: int):
+    """One-hot (nx*ny, nx+ny+1) matrix mapping outer-product cell (i,j) to
+    product column i+j. Turns schoolbook multiplication into one dense
+    matmul — the MXU-friendly formulation of limb convolution."""
+    k = nx + ny + 1
+    c = np.zeros((nx, ny, k), dtype=np.int32)
+    for i in range(nx):
+        for j in range(ny):
+            c[i, j, i + j] = 1
+    return jnp.asarray(c.reshape(nx * ny, k))
+
+
+def mul_full(x, y):
+    """Full product of two limb vectors -> nx+ny+1 canonical limbs.
+
+    Outer products are < 2^16 and each column accumulates < 2*NLIMBS
+    of them: everything stays inside int32.
+    """
+    nx, ny = x.shape[-1], y.shape[-1]
+    prod = x[..., :, None] * y[..., None, :]
+    flat = prod.reshape(prod.shape[:-2] + (nx * ny,))
+    acc = flat @ _conv_matrix(nx, ny)
+    return normalize(acc)
+
+
+def mul_low(x, y, keep=None):
+    """Low `keep` limbs of the product (i.e. product mod RADIX^keep)."""
+    keep = x.shape[-1] if keep is None else keep
+    return mul_full(x, y)[..., :keep]
