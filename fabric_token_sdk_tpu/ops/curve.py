@@ -1,0 +1,251 @@
+"""Batched BN254 G1 group ops on limb tensors (Jacobian, branch-free).
+
+A batch of points is one int32 tensor of shape (..., 3, NLIMBS): Jacobian
+(X, Y, Z) in Montgomery form, Z == 0 encoding infinity. All formulas are
+select-based (no data-dependent branches) so they vmap/jit/shard cleanly —
+the TPU-first counterpart of gnark's per-point assembly used by the
+reference via IBM mathlib (`*math.G1`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import limbs as lb
+from .field import FP, FR
+from ..crypto import hostmath as hm
+
+
+def infinity(shape=()) -> jnp.ndarray:
+    """Batch of points at infinity."""
+    return jnp.zeros(tuple(shape) + (3, lb.NLIMBS), dtype=jnp.int32)
+
+
+def is_infinity(p):
+    return FP.is_zero(p[..., 2, :])
+
+
+def neg(p):
+    return p.at[..., 1, :].set(FP.neg(p[..., 1, :]))
+
+
+@jax.jit
+def double(p):
+    """dbl-2009-l (a=0): branch-free; Z=0 and Y=0 fall out naturally."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = FP.sqr(x)
+    b = FP.sqr(y)
+    c = FP.sqr(b)
+    d = FP.sub(FP.sqr(FP.add(x, b)), FP.add(a, c))
+    d = FP.add(d, d)
+    e = FP.add(FP.add(a, a), a)
+    f = FP.sqr(e)
+    x3 = FP.sub(f, FP.add(d, d))
+    c8 = FP.add(c, c)
+    c8 = FP.add(c8, c8)
+    c8 = FP.add(c8, c8)
+    y3 = FP.sub(FP.mul(e, FP.sub(d, x3)), c8)
+    z3 = FP.mul(FP.add(y, y), z)
+    return jnp.stack([x3, y3, z3], axis=-2)
+
+
+@jax.jit
+def add(p, q):
+    """General Jacobian addition (add-2007-bl) with select-based edge cases:
+    either operand at infinity, P == Q (doubling), P == -Q (infinity)."""
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    z1z1 = FP.sqr(z1)
+    z2z2 = FP.sqr(z2)
+    u1 = FP.mul(x1, z2z2)
+    u2 = FP.mul(x2, z1z1)
+    s1 = FP.mul(FP.mul(y1, z2), z2z2)
+    s2 = FP.mul(FP.mul(y2, z1), z1z1)
+    h = FP.sub(u2, u1)
+    i = FP.sqr(FP.add(h, h))
+    j = FP.mul(h, i)
+    rr = FP.sub(s2, s1)
+    rr = FP.add(rr, rr)
+    v = FP.mul(u1, i)
+    x3 = FP.sub(FP.sqr(rr), FP.add(j, FP.add(v, v)))
+    s1j = FP.mul(s1, j)
+    y3 = FP.sub(FP.mul(rr, FP.sub(v, x3)), FP.add(s1j, s1j))
+    z3 = FP.mul(FP.sub(FP.sqr(FP.add(z1, z2)), FP.add(z1z1, z2z2)), h)
+    out = jnp.stack([x3, y3, z3], axis=-2)
+
+    same_x = FP.is_zero(h)
+    same_y = FP.is_zero(rr)
+    inf1 = FP.is_zero(z1)
+    inf2 = FP.is_zero(z2)
+    # P == Q (and neither infinite): use the doubling formula
+    out = jnp.where((same_x & same_y & ~inf1 & ~inf2)[..., None, None], double(p), out)
+    # P == -Q: infinity (out.Z is already 0 since h == 0 => z3 == 0, but X/Y
+    # are garbage; zero the whole point for canonical equality)
+    out = jnp.where(
+        (same_x & ~same_y & ~inf1 & ~inf2)[..., None, None], jnp.zeros_like(out), out
+    )
+    out = jnp.where(inf1[..., None, None], q, out)
+    out = jnp.where(inf2[..., None, None], p, out)
+    return out
+
+
+@jax.jit
+def eq(p, q):
+    """Equality in Jacobian coordinates (cross-multiplied, batch-wise)."""
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    z1z1 = FP.sqr(z1)
+    z2z2 = FP.sqr(z2)
+    xe = FP.eq(FP.mul(x1, z2z2), FP.mul(x2, z1z1))
+    ye = FP.eq(FP.mul(FP.mul(y1, z2), z2z2), FP.mul(FP.mul(y2, z1), z1z1))
+    inf1 = FP.is_zero(z1)
+    inf2 = FP.is_zero(z2)
+    return jnp.where(inf1 | inf2, inf1 == inf2, xe & ye)
+
+
+def scalar_bits(k_canon, nbits: int = 256):
+    """Canonical (non-Montgomery) limb scalars (..., NLIMBS) -> bits
+    (..., nbits), most significant first."""
+    shifts = jnp.arange(lb.RADIX_BITS, dtype=jnp.int32)
+    bits = (k_canon[..., :, None] >> shifts[None, :]) & 1  # (..., NLIMBS, 8) LSB-first
+    flat = bits.reshape(bits.shape[:-2] + (lb.NLIMBS * lb.RADIX_BITS,))
+    return flat[..., :nbits][..., ::-1]  # MSB first
+
+
+@jax.jit
+def scalar_mul(p, k_canon):
+    """Batched double-and-add: (..., 3, L) x (..., L) -> (..., 3, L).
+
+    k_canon is a canonical (non-Montgomery) limb scalar. 256 scan steps.
+    """
+    bits = scalar_bits(k_canon)  # (..., 256) MSB first
+    bits_t = jnp.moveaxis(bits, -1, 0)  # (256, ...)
+
+    def step(acc, bit):
+        acc = double(acc)
+        acc = jnp.where(bit[..., None, None] > 0, add(acc, p), acc)
+        return acc, None
+
+    out, _ = lax.scan(step, infinity(p.shape[:-2]), bits_t)
+    return out
+
+
+def tree_sum(points, axis: int = -3):
+    """Sum a batch of points along `axis` via log-depth pairwise adds."""
+    points = jnp.moveaxis(points, axis, 0)
+    n = points.shape[0]
+    while n > 1:
+        half = n // 2
+        odd = points[2 * half :]  # 0 or 1 leftover
+        points = add(points[:half], points[half : 2 * half])
+        if odd.shape[0]:
+            points = jnp.concatenate([points, odd], axis=0)
+        n = points.shape[0]
+    return points[0]
+
+
+# ---------------------------------------------------------------- host I/O
+
+def encode_point(pt) -> np.ndarray:
+    """Host affine (x, y) or None -> (3, NLIMBS) Montgomery Jacobian."""
+    if pt is None:
+        return np.zeros((3, lb.NLIMBS), dtype=np.int32)
+    R = 1 << (lb.RADIX_BITS * lb.NLIMBS)
+    x, y = pt
+    return np.stack(
+        [
+            lb.int_to_limbs(x * R % hm.P),
+            lb.int_to_limbs(y * R % hm.P),
+            lb.int_to_limbs(R % hm.P),
+        ]
+    )
+
+
+def encode_points(pts) -> jnp.ndarray:
+    return jnp.asarray(np.stack([encode_point(p) for p in pts]))
+
+
+def decode_points(arr):
+    """Device (..., 3, NLIMBS) -> host affine tuples (inversion on host)."""
+    flat = np.asarray(FP.from_mont(arr)).reshape(-1, 3, lb.NLIMBS)
+    out = []
+    for row in flat:
+        x, y, z = (lb.limbs_to_int(c) for c in row)
+        if z == 0:
+            out.append(None)
+            continue
+        zinv = hm.fp_inv(z)
+        zi2 = zinv * zinv % hm.P
+        out.append((x * zi2 % hm.P, y * zi2 % hm.P * zinv % hm.P))
+    return out
+
+
+def decode_point(arr):
+    return decode_points(arr[None])[0]
+
+
+def encode_scalars(ks) -> jnp.ndarray:
+    """Host ints -> canonical limb scalars (N, NLIMBS)."""
+    return jnp.asarray(lb.ints_to_limbs([k % hm.R for k in ks]))
+
+
+# ---------------------------------------------------------------- fixed base
+
+WINDOW_BITS = 4
+DIGITS_PER_SCALAR = 256 // WINDOW_BITS  # 64
+
+
+class FixedBaseTable:
+    """Windowed multiples of a list of fixed bases for batched multiexp.
+
+    Table[b, w, d] = base_b * (d << (4w)), shape (nbases, 64, 16, 3, L).
+    A multiexp is then: one-hot digit selection (a dense matmul riding the
+    MXU) followed by a log-depth tree of point additions.
+
+    Used for the Pedersen-parameter bases (reference: PedParams/PedGen in
+    setup.go) — the hottest multiexp in issue/transfer proving and
+    verification.
+    """
+
+    def __init__(self, host_points):
+        self.nbases = len(host_points)
+        tables = np.zeros(
+            (self.nbases, DIGITS_PER_SCALAR, 1 << WINDOW_BITS, 3, lb.NLIMBS),
+            dtype=np.int32,
+        )
+        for b, pt in enumerate(host_points):
+            for w in range(DIGITS_PER_SCALAR):
+                step = hm.g1_mul(pt, (1 << (WINDOW_BITS * w)) % hm.R)
+                acc = None
+                for d in range(1 << WINDOW_BITS):
+                    tables[b, w, d] = encode_point(acc)
+                    acc = hm.g1_add(acc, step)
+        # flatten for the one-hot contraction: (nbases*64, 16, 3*L)
+        self.flat = jnp.asarray(
+            tables.reshape(self.nbases * DIGITS_PER_SCALAR, 1 << WINDOW_BITS, 3 * lb.NLIMBS)
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def msm(self, scalars):
+        """scalars: canonical limb tensor (..., nbases, NLIMBS) ->
+        points (..., 3, NLIMBS) = sum_b scalar_b * base_b."""
+        shifts = jnp.arange(0, lb.RADIX_BITS, WINDOW_BITS, dtype=jnp.int32)
+        digs = (scalars[..., :, :, None] >> shifts) & ((1 << WINDOW_BITS) - 1)
+        # (..., nbases, NLIMBS * 2) -> (..., nbases*64)
+        digs = digs.reshape(digs.shape[:-3] + (self.nbases * DIGITS_PER_SCALAR,))
+        onehot = (digs[..., None] == jnp.arange(1 << WINDOW_BITS, dtype=jnp.int32)).astype(
+            jnp.int32
+        )  # (..., nbases*64, 16)
+        sel = jnp.einsum("...td,tdc->...tc", onehot, self.flat)
+        sel = sel.reshape(sel.shape[:-1] + (3, lb.NLIMBS))
+        return tree_sum(sel, axis=-3)
+
+
+@functools.lru_cache(maxsize=8)
+def generator_table(n: int = 1) -> FixedBaseTable:
+    return FixedBaseTable([hm.G1_GEN] * n)

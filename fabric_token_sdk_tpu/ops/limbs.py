@@ -120,7 +120,9 @@ def _conv_matrix(nx: int, ny: int):
     for i in range(nx):
         for j in range(ny):
             c[i, j, i + j] = 1
-    return jnp.asarray(c.reshape(nx * ny, k))
+    # NOTE: return the numpy constant — converting to a jax array here would
+    # cache a tracer when first called under an active trace.
+    return c.reshape(nx * ny, k)
 
 
 def mul_full(x, y):

@@ -1,0 +1,81 @@
+"""Differential tests: batched G1 kernels vs host curve math."""
+import jax.numpy as jnp
+import numpy as np
+
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+from fabric_token_sdk_tpu.ops import curve as cv
+
+
+def _host_pts(rng, n):
+    return [hm.rand_g1(rng) for _ in range(n)]
+
+
+def test_point_roundtrip(rng):
+    pts = _host_pts(rng, 4) + [None]
+    assert cv.decode_points(cv.encode_points(pts)) == pts
+
+
+def test_double_add_matches_host(rng):
+    pts = _host_pts(rng, 4)
+    P = cv.encode_points(pts)
+    assert cv.decode_points(cv.double(P)) == [hm.g1_double(p) for p in pts]
+    qs = _host_pts(rng, 4)
+    Q = cv.encode_points(qs)
+    assert cv.decode_points(cv.add(P, Q)) == [hm.g1_add(p, q) for p, q in zip(pts, qs)]
+
+
+def test_add_edge_cases(rng):
+    p = _host_pts(rng, 1)[0]
+    P = cv.encode_points([p, p, p, None, None])
+    Q = cv.encode_points([p, hm.g1_neg(p), None, p, None])
+    got = cv.decode_points(cv.add(P, Q))
+    assert got == [hm.g1_double(p), None, p, p, None]
+
+
+def test_eq(rng):
+    p, q = _host_pts(rng, 2)
+    # same point with different Z (scale Jacobian coords)
+    P = cv.encode_points([p, p, None, p])
+    P2 = cv.double(cv.encode_points([p, q, None, None]))
+    Pd = cv.encode_points([hm.g1_double(p), hm.g1_double(q), None, None])
+    assert np.asarray(cv.eq(P2, Pd)).tolist() == [True, True, True, True]
+    # point!=point, point==point, inf vs point, point vs inf
+    assert np.asarray(cv.eq(P, cv.encode_points([q, p, p, None]))).tolist() == [
+        False,
+        True,
+        False,
+        False,
+    ]
+
+
+def test_scalar_mul_matches_host(rng):
+    pts = _host_pts(rng, 3)
+    ks = [rng.randrange(hm.R) for _ in range(3)]
+    got = cv.decode_points(cv.scalar_mul(cv.encode_points(pts), cv.encode_scalars(ks)))
+    assert got == [hm.g1_mul(p, k) for p, k in zip(pts, ks)]
+
+
+def test_scalar_mul_edges(rng):
+    p = _host_pts(rng, 1)[0]
+    P = cv.encode_points([p, p, p])
+    ks = cv.encode_scalars([0, 1, hm.R - 1])
+    got = cv.decode_points(cv.scalar_mul(P, ks))
+    assert got == [None, p, hm.g1_neg(p)]
+
+
+def test_tree_sum(rng):
+    pts = _host_pts(rng, 5)
+    arr = cv.encode_points(pts)  # (5, 3, L)
+    got = cv.decode_point(cv.tree_sum(arr, axis=0))
+    assert got == hm.g1_sum(pts)
+
+
+def test_fixed_base_msm(rng):
+    bases = _host_pts(rng, 3)
+    table = cv.FixedBaseTable(bases)
+    B = 4
+    scal = [[rng.randrange(hm.R) for _ in range(3)] for _ in range(B)]
+    S = jnp.stack([cv.encode_scalars(row) for row in scal])  # (B, 3, L)
+    got = cv.decode_points(table.msm(S))
+    want = [hm.g1_multiexp(bases, row) for row in scal]
+    assert got == want
