@@ -1,0 +1,48 @@
+"""Pedersen vector commitments (host scalar path + batched TPU path).
+
+Reference: `crypto/common/zkproof.go` ComputePedersenCommitment and the
+token commitment computation in `crypto/token/token.go:64-76` (token data =
+commit(hash(type), value; bf) over PedParams).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import hostmath as hm
+from ..ops import curve as cv
+
+
+def commit(openings: Sequence[int], bases: Sequence, curve=None):
+    """Host: com = prod bases[i]^openings[i]."""
+    if len(openings) != len(bases):
+        raise ValueError(f"pedersen commit: {len(openings)} openings vs {len(bases)} bases")
+    return hm.g1_multiexp(list(bases), [o % hm.R for o in openings])
+
+
+class BatchedPedersen:
+    """Batched fixed-base committer: B commitments over the same bases in
+    one device program (one-hot window lookups + tree add)."""
+
+    def __init__(self, bases: Sequence):
+        self.bases = list(bases)
+        self.table = cv.FixedBaseTable(self.bases)
+
+    def commit_batch(self, openings_rows: Sequence[Sequence[int]]):
+        """rows of per-base openings -> list of host G1 points."""
+        scal = jnp.stack([cv.encode_scalars(row) for row in openings_rows])
+        return cv.decode_points(self.table.msm(scal))
+
+    def commit_device(self, scalars):
+        """Device path: scalars (..., nbases, NLIMBS) canonical -> points."""
+        return self.table.msm(scalars)
+
+
+def token_commitment(token_type: str, value: int, bf: int, ped_params: Sequence):
+    """Commitment to (hash(type), value; blinding) — TokenData.
+
+    Reference: token/token.go:68-69.
+    """
+    return commit([hm.hash_to_zr(token_type.encode()), value, bf], ped_params)

@@ -1,0 +1,59 @@
+"""Long-term identity signatures: Schnorr over BN254 G1.
+
+Capability parity with reference `crypto/ecdsa/ecdsa.go` (signing
+identities for issuers/auditors built on mathlib curves). We use Schnorr
+rather than ECDSA — same API shape (keygen/sign/verify, serializable
+public keys), simpler and pairing-free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from . import hostmath as hm
+from .serialization import guard, dumps, g1s_bytes, loads
+
+
+@dataclass
+class PublicKey:
+    point: tuple  # G1 = g^sk
+
+    def to_bytes(self) -> bytes:
+        return hm.g1_to_bytes(self.point)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PublicKey":
+        return cls(hm.g1_from_bytes(raw))
+
+    @guard
+    def verify(self, message: bytes, sig_raw: bytes) -> None:
+        d = loads(sig_raw)
+        chal, resp = d["c"], d["z"]
+        # com = g^z / pk^c ; challenge must rebind
+        com = hm.g1_add(
+            hm.g1_mul(hm.G1_GEN, resp), hm.g1_neg(hm.g1_mul(self.point, chal))
+        )
+        if _challenge(self.point, com, message) != chal:
+            raise ValueError("invalid signature")
+
+
+@dataclass
+class SigningKey:
+    sk: int
+    public: PublicKey
+
+    def sign(self, message: bytes, rng=None) -> bytes:
+        rho = hm.rand_zr(rng)
+        com = hm.g1_mul(hm.G1_GEN, rho)
+        chal = _challenge(self.public.point, com, message)
+        return dumps({"c": chal, "z": (rho + chal * self.sk) % hm.R})
+
+
+def keygen(rng=None) -> SigningKey:
+    sk = hm.rand_zr(rng)
+    return SigningKey(sk, PublicKey(hm.g1_mul(hm.G1_GEN, sk)))
+
+
+def _challenge(pk_point, com, message: bytes) -> int:
+    return hm.hash_to_zr(message + g1s_bytes([pk_point, com]), b"fts/schnorr-sig")

@@ -1,0 +1,105 @@
+"""Tests: o2omp, nym signatures, identity signatures, audit checks."""
+import pytest
+
+from fabric_token_sdk_tpu.crypto import audit, hostmath as hm, nym, o2omp, pedersen, sign
+from fabric_token_sdk_tpu.crypto.token import Metadata, Token
+
+
+def test_o2omp_roundtrip(rng):
+    ped = [hm.rand_g1(rng), hm.rand_g1(rng)]
+    nbits = 3
+    n = 1 << nbits
+    index = 5
+    r = hm.rand_zr(rng)
+    commitments = [hm.rand_g1(rng) for _ in range(n)]
+    commitments[index] = hm.g1_mul(ped[1], r)  # commitment to 0
+    raw = o2omp.Prover(commitments, b"msg", ped, nbits, index, r, rng).prove()
+    o2omp.Verifier(commitments, b"msg", ped, nbits).verify(raw)
+    # different message binds -> reject
+    with pytest.raises(ValueError):
+        o2omp.Verifier(commitments, b"other", ped, nbits).verify(raw)
+    # commitment list without a commitment to zero -> reject
+    commitments[index] = hm.rand_g1(rng)
+    with pytest.raises(ValueError):
+        o2omp.Verifier(commitments, b"msg", ped, nbits).verify(raw)
+
+
+def test_nym_signature(rng):
+    params = [hm.rand_g1(rng), hm.rand_g1(rng)]
+    sk = hm.rand_zr(rng)
+    ny, bf = nym.new_nym(sk, params, rng)
+    signer = nym.NymSigner(sk, bf, ny, params)
+    raw = signer.sign(b"transfer-tx-1", rng)
+    nym.NymVerifier(ny, params).verify(b"transfer-tx-1", raw)
+    with pytest.raises(ValueError):
+        nym.NymVerifier(ny, params).verify(b"transfer-tx-2", raw)
+    other, _ = nym.new_nym(hm.rand_zr(rng), params, rng)
+    with pytest.raises(ValueError):
+        nym.NymVerifier(other, params).verify(b"transfer-tx-1", raw)
+
+
+def test_identity_signature(rng):
+    key = sign.keygen(rng)
+    sig = key.sign(b"hello", rng)
+    key.public.verify(b"hello", sig)
+    pk2 = sign.PublicKey.from_bytes(key.public.to_bytes())
+    pk2.verify(b"hello", sig)
+    with pytest.raises(ValueError):
+        key.public.verify(b"tampered", sig)
+
+
+def test_auditor_check(rng):
+    ped = [hm.rand_g1(rng) for _ in range(3)]
+    bf = hm.rand_zr(rng)
+    com = pedersen.token_commitment("USD", 9, bf, ped)
+    t = Token(owner=b"alice", data=com)
+    at = audit.auditable_token(t, b"alice-audit-info", "USD", 9, bf)
+    key = sign.keygen(rng)
+    auditor = audit.Auditor(ped, signer=key)
+    auditor.check([at], [])
+    sig = auditor.endorse(b"request", rng)
+    key.public.verify(b"request", sig)
+    bad = audit.auditable_token(t, b"", "USD", 8, bf)
+    with pytest.raises(ValueError):
+        auditor.check_token(bad)
+
+
+def test_codec_hexlike_strings():
+    """Token types that look like hex ints must survive the wire format."""
+    from fabric_token_sdk_tpu.crypto.token import Metadata
+
+    m = Metadata("0xBEEF", 5, 7)
+    m2 = Metadata.from_bytes(m.to_bytes())
+    assert m2.token_type == "0xBEEF" and isinstance(m2.token_type, str)
+
+
+def test_malformed_proof_rejected_not_crash(rng):
+    """Garbage bytes must raise ValueError, never TypeError/KeyError."""
+    from fabric_token_sdk_tpu.crypto import o2omp, wellformedness as wf
+    from fabric_token_sdk_tpu.crypto.serialization import dumps
+
+    ped = [hm.rand_g1(rng), hm.rand_g1(rng)]
+    v = o2omp.Verifier([hm.rand_g1(rng) for _ in range(4)], b"m", ped, 2)
+    for garbage in [b"not json", dumps({"L": [5], "A": []}), dumps({"x": 1})]:
+        with pytest.raises(ValueError):
+            v.verify(garbage)
+    tv = wf.TransferWFVerifier(ped + [hm.rand_g1(rng)], [hm.rand_g1(rng)], [hm.rand_g1(rng)])
+    with pytest.raises(ValueError):
+        tv.verify(b"\xff\xfe garbage")
+
+
+def test_public_params_g2_subgroup_validation(rng):
+    """Tampered params with wrong-subgroup G2 must fail validation."""
+    from fabric_token_sdk_tpu.crypto.setup import setup
+
+    pp = setup(base=2, exponent=1, rng=rng)
+    pp.validate()
+    # find an on-curve, non-subgroup twist point
+    while True:
+        x = (rng.randrange(hm.P), rng.randrange(hm.P))
+        y = hm.fp2_sqrt(hm.fp2_add(hm.fp2_mul(hm.fp2_sqr(x), x), hm.B2))
+        if y is not None and not hm.g2_in_subgroup((x, y)):
+            pp.range_params.Q = (x, y)
+            break
+    with pytest.raises(ValueError):
+        pp.validate()

@@ -1,0 +1,181 @@
+"""Protocol round trips + tamper rejection (host control plane)."""
+import pytest
+
+from fabric_token_sdk_tpu.crypto import (
+    elgamal,
+    hostmath as hm,
+    pedersen,
+    pssign,
+    rangeproof,
+    sigproof,
+    transfer,
+    issue as issue_mod,
+    token as tok,
+    wellformedness as wf,
+)
+from fabric_token_sdk_tpu.crypto.setup import PublicParams, setup
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup(base=4, exponent=2)  # max value 15 — keeps pairings cheap
+
+
+def test_setup_serialize_roundtrip(pp):
+    raw = pp.serialize()
+    pp2 = PublicParams.deserialize(raw)
+    assert pp2.ped_params == pp.ped_params
+    assert pp2.range_params.Q == pp.range_params.Q
+    assert pp2.max_token_value() == 15
+    pp2.validate()
+    assert pp.compute_hash() == pp2.compute_hash()
+
+
+def test_elgamal_roundtrip(rng):
+    sk = elgamal.keygen(rng=rng)
+    m = hm.rand_g1(rng)
+    ct, _ = sk.pk.encrypt(m, rng)
+    assert sk.decrypt(ct) == m
+
+
+def test_pssign_roundtrip(rng):
+    signer = pssign.keygen(2, rng)
+    msgs = [5, 11]
+    sig = signer.sign(msgs, rng)
+    signer.verify(msgs, sig)
+    rnd = signer.randomize(sig, rng)
+    signer.verify(msgs, rnd)  # randomized sig still verifies
+    with pytest.raises(ValueError):
+        signer.verify([5, 12], sig)
+
+
+def test_ps_blind_sign(rng):
+    signer = pssign.keygen(2, rng)
+    ped = [hm.rand_g1(rng) for _ in range(3)]  # 2 message bases + bf base
+    msgs = [3, 9]
+    bf = hm.rand_zr(rng)
+    com = hm.g1_multiexp(ped, msgs + [bf])
+    enc_sk = elgamal.keygen(rng=rng)
+    verifier = pssign.VerifierWithHash(pk=signer.pk, Q=signer.Q)
+    rec = pssign.Recipient(msgs, bf, com, enc_sk, ped, verifier, rng)
+    req = rec.request()
+    blind_signer = pssign.BlindSigner(signer, ped)
+    resp = blind_signer.blind_sign(req)
+    sig = rec.unblind(resp)  # verifies internally
+    assert sig.R is not None and sig.S is not None
+    # tampered request must be rejected
+    req2 = rec.request()
+    req2.proof.messages[0] = (req2.proof.messages[0] + 1) % hm.R
+    with pytest.raises(ValueError):
+        blind_signer.blind_sign(req2)
+
+
+def test_membership_proof(rng, pp):
+    rp = pp.range_params
+    value = 3
+    bf = hm.rand_zr(rng)
+    com = hm.g1_multiexp(pp.ped_params[:2], [value, bf])
+    w = sigproof.MembershipWitness(rp.signed_values[value], value, bf)
+    proof = sigproof.MembershipProver(
+        w, com, pp.ped_gen, rp.Q, rp.sign_pk, pp.ped_params[:2], rng
+    ).prove()
+    sigproof.MembershipVerifier(
+        com, pp.ped_gen, rp.Q, rp.sign_pk, pp.ped_params[:2]
+    ).verify(proof)
+    # value NOT in the signed relationship with this commitment -> reject
+    proof.value_resp = (proof.value_resp + 1) % hm.R
+    with pytest.raises(ValueError):
+        sigproof.MembershipVerifier(
+            com, pp.ped_gen, rp.Q, rp.sign_pk, pp.ped_params[:2]
+        ).verify(proof)
+
+
+def test_range_proof(rng, pp):
+    rp = pp.range_params
+    tokens, wits = tok.tokens_with_witness([7, 14], "USD", pp.ped_params, rng)
+    prover = rangeproof.RangeProver(
+        [rangeproof.TokenWitness(w.token_type, w.value, w.bf) for w in wits],
+        tokens, rp.signed_values, rp.base, rp.exponent,
+        pp.ped_params, rp.sign_pk, pp.ped_gen, rp.Q, rng,
+    )
+    raw = prover.prove()
+    rangeproof.RangeVerifier(
+        tokens, rp.base, rp.exponent, pp.ped_params, rp.sign_pk, pp.ped_gen, rp.Q
+    ).verify(raw)
+
+
+def test_range_proof_out_of_range(rng, pp):
+    rp = pp.range_params
+    tokens, wits = tok.tokens_with_witness([16], "USD", pp.ped_params, rng)  # > 15
+    with pytest.raises(ValueError):
+        rangeproof.RangeProver(
+            [rangeproof.TokenWitness(w.token_type, w.value, w.bf) for w in wits],
+            tokens, rp.signed_values, rp.base, rp.exponent,
+            pp.ped_params, rp.sign_pk, pp.ped_gen, rp.Q, rng,
+        ).prove()
+
+
+def test_transfer_wf(rng, pp):
+    in_toks, in_w = tok.tokens_with_witness([5, 10], "USD", pp.ped_params, rng)
+    out_toks, out_w = tok.tokens_with_witness([7, 8], "USD", pp.ped_params, rng)
+    prover = wf.TransferWFProver(
+        wf.TransferWFWitness(
+            "USD",
+            [w.value for w in in_w], [w.bf for w in in_w],
+            [w.value for w in out_w], [w.bf for w in out_w],
+        ),
+        pp.ped_params, in_toks, out_toks, rng,
+    )
+    raw = prover.prove()
+    wf.TransferWFVerifier(pp.ped_params, in_toks, out_toks).verify(raw)
+    # unbalanced transfer must fail
+    out_bad, out_bw = tok.tokens_with_witness([7, 9], "USD", pp.ped_params, rng)
+    bad = wf.TransferWFProver(
+        wf.TransferWFWitness(
+            "USD",
+            [w.value for w in in_w], [w.bf for w in in_w],
+            [w.value for w in out_bw], [w.bf for w in out_bw],
+        ),
+        pp.ped_params, in_toks, out_bad, rng,
+    ).prove()
+    with pytest.raises(ValueError):
+        wf.TransferWFVerifier(pp.ped_params, in_toks, out_bad).verify(bad)
+
+
+def test_full_transfer_proof(rng, pp):
+    in_toks, in_w = tok.tokens_with_witness([5, 10], "USD", pp.ped_params, rng)
+    out_toks, out_w = tok.tokens_with_witness([12, 3], "USD", pp.ped_params, rng)
+    raw = transfer.TransferProver(in_w, out_w, in_toks, out_toks, pp, rng).prove()
+    transfer.TransferVerifier(in_toks, out_toks, pp).verify(raw)
+    # swapped outputs -> stale proof must not verify
+    with pytest.raises(ValueError):
+        transfer.TransferVerifier(in_toks, list(reversed(out_toks)), pp).verify(raw)
+
+
+def test_ownership_transfer_skips_range(rng, pp):
+    in_toks, in_w = tok.tokens_with_witness([9], "USD", pp.ped_params, rng)
+    out_toks, out_w = tok.tokens_with_witness([9], "USD", pp.ped_params, rng)
+    raw = transfer.TransferProver(in_w, out_w, in_toks, out_toks, pp, rng).prove()
+    assert transfer.TransferProof.from_bytes(raw).range_correctness is None
+    transfer.TransferVerifier(in_toks, out_toks, pp).verify(raw)
+
+
+@pytest.mark.parametrize("anonymous", [True, False])
+def test_issue_proof(rng, pp, anonymous):
+    tokens, wits = tok.tokens_with_witness([6, 9], "EUR", pp.ped_params, rng)
+    raw = issue_mod.IssueProver(wits, tokens, anonymous, pp, rng).prove()
+    issue_mod.IssueVerifier(tokens, anonymous, pp).verify(raw)
+    # issue with a different type must not verify against these tokens
+    tokens2, wits2 = tok.tokens_with_witness([6, 9], "USD", pp.ped_params, rng)
+    with pytest.raises(ValueError):
+        issue_mod.IssueVerifier(tokens2, anonymous, pp).verify(raw)
+
+
+def test_token_in_the_clear(rng, pp):
+    tokens, wits = tok.tokens_with_witness([5], "USD", pp.ped_params, rng)
+    t = tok.Token(owner=b"alice", data=tokens[0])
+    meta = tok.Metadata("USD", 5, wits[0].bf, owner=b"alice")
+    assert tok.token_in_the_clear(t, meta, pp.ped_params) == ("USD", 5, b"alice")
+    meta_bad = tok.Metadata("USD", 6, wits[0].bf)
+    with pytest.raises(ValueError):
+        tok.token_in_the_clear(t, meta_bad, pp.ped_params)
