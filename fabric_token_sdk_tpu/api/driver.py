@@ -1,0 +1,117 @@
+"""Driver SPI — what a token driver must implement.
+
+Reference: `token/driver/driver.go`, `issue.go`, `transfer.go`,
+`validator.go`, `wallet.go`. A driver owns the privacy model: how tokens
+are represented on the ledger, how actions are proven and validated, and
+how identities sign.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models.token import ID, Token, UnspentToken
+
+
+class ValidationError(Exception):
+    """A token request failed validation."""
+
+
+def vguard(fn):
+    """Decorator for driver validate entry points: structural errors from
+    attacker-supplied action bytes become ValidationError, never KeyError/
+    TypeError/ValueError leaks (cf. crypto.serialization.guard)."""
+
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except ValidationError:
+            raise
+        except Exception as e:
+            raise ValidationError(
+                f"malformed action: {type(e).__name__}: {e}"
+            ) from e
+
+    wrapped.__name__ = fn.__name__
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
+@dataclass
+class IssueOutcome:
+    """Result of assembling an issue action."""
+
+    action_bytes: bytes
+    outputs: List[bytes]  # serialized on-ledger outputs
+    metadata: List[bytes]  # per-output opening metadata (off-chain)
+
+
+@dataclass
+class TransferOutcome:
+    action_bytes: bytes
+    outputs: List[bytes]
+    metadata: List[bytes]
+
+
+class Driver(abc.ABC):
+    """A token driver (privacy model + crypto backend)."""
+
+    name: str = ""
+
+    # ------------------------------------------------------------ params
+
+    @abc.abstractmethod
+    def public_params(self):
+        ...
+
+    @abc.abstractmethod
+    def precision(self) -> int:
+        ...
+
+    # ------------------------------------------------------------ actions
+
+    @abc.abstractmethod
+    def issue(self, issuer_identity: bytes, token_type: str, values: Sequence[int],
+              owners: Sequence[bytes], anonymous: bool = True) -> IssueOutcome:
+        ...
+
+    @abc.abstractmethod
+    def transfer(self, input_ids: Sequence[ID], input_tokens: Sequence[bytes],
+                 input_metadata: Sequence[bytes], token_type: str,
+                 values: Sequence[int], owners: Sequence[bytes]) -> TransferOutcome:
+        ...
+
+    # ------------------------------------------------------------ validate
+
+    @abc.abstractmethod
+    def validate_issue(self, action_bytes: bytes) -> Tuple[List[bytes], bytes]:
+        """Validate an issue action; returns (serialized outputs to write,
+        issuer identity whose signature the request must carry — empty for
+        anonymous issuance where the proof itself authorizes)."""
+
+    @abc.abstractmethod
+    def validate_transfer(self, action_bytes: bytes,
+                          resolve_input,  # Callable[[ID], bytes]
+                          signed_payload: bytes,
+                          signatures: Sequence[bytes]) -> Tuple[List[ID], List[bytes]]:
+        """Validate a transfer action; returns (spent ids, outputs to write)."""
+
+    # ------------------------------------------------------------ tokens
+
+    @abc.abstractmethod
+    def output_to_unspent(self, token_id: ID, output_bytes: bytes,
+                          metadata_bytes: Optional[bytes]) -> UnspentToken:
+        """Interpret a ledger output (+optional metadata) as a clear token."""
+
+    @abc.abstractmethod
+    def output_owner(self, output_bytes: bytes) -> bytes:
+        ...
+
+    # ------------------------------------------------------------ identity
+
+    @abc.abstractmethod
+    def verify_owner_signature(self, owner_identity: bytes, message: bytes,
+                               signature: bytes) -> None:
+        ...

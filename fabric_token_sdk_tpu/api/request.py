@@ -1,0 +1,145 @@
+"""TokenRequest: the unit a transaction carries to the ledger.
+
+Reference: `token/request.go` — a request aggregates issue/transfer
+actions, collects owner/issuer/auditor signatures, and carries per-output
+metadata off-chain (`token/metadata.go`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..crypto.serialization import dumps, loads
+from ..models.token import ID
+
+
+@dataclass
+class IssueRecord:
+    action: bytes
+    issuer: bytes
+    outputs_metadata: List[bytes] = field(default_factory=list)
+    receivers: List[bytes] = field(default_factory=list)
+    signature: bytes = b""
+
+
+@dataclass
+class TransferRecord:
+    action: bytes
+    input_ids: List[ID] = field(default_factory=list)
+    senders: List[bytes] = field(default_factory=list)
+    outputs_metadata: List[bytes] = field(default_factory=list)
+    receivers: List[bytes] = field(default_factory=list)
+    signatures: List[bytes] = field(default_factory=list)
+
+
+@dataclass
+class RequestMetadata:
+    """Off-chain opening metadata + application metadata."""
+
+    application: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class TokenRequest:
+    anchor: str  # tx id this request is bound to
+    issues: List[IssueRecord] = field(default_factory=list)
+    transfers: List[TransferRecord] = field(default_factory=list)
+    auditor_signature: bytes = b""
+    metadata: RequestMetadata = field(default_factory=RequestMetadata)
+
+    # ------------------------------------------------------------ marshal
+
+    def _actions_dict(self) -> dict:
+        return {
+            "anchor": self.anchor,
+            "issues": [
+                {"a": r.action, "i": r.issuer} for r in self.issues
+            ],
+            "transfers": [
+                {
+                    "a": r.action,
+                    "ids": [[i.tx_id, i.index] for i in r.input_ids],
+                    "s": r.senders,
+                }
+                for r in self.transfers
+            ],
+        }
+
+    def marshal_to_sign(self) -> bytes:
+        """Byte string signed by owners/issuers (reference request.go:655)."""
+        return dumps(self._actions_dict())
+
+    def marshal_to_audit(self) -> bytes:
+        """Byte string signed by the auditor (reference request.go:643):
+        actions + metadata binding."""
+        d = self._actions_dict()
+        d["meta"] = {
+            "issues": [r.outputs_metadata for r in self.issues],
+            "transfers": [r.outputs_metadata for r in self.transfers],
+        }
+        return dumps(d)
+
+    def to_bytes(self) -> bytes:
+        return dumps(
+            {
+                "anchor": self.anchor,
+                "issues": [
+                    {
+                        "a": r.action,
+                        "i": r.issuer,
+                        "m": r.outputs_metadata,
+                        "r": r.receivers,
+                        "sig": r.signature,
+                    }
+                    for r in self.issues
+                ],
+                "transfers": [
+                    {
+                        "a": r.action,
+                        "ids": [[i.tx_id, i.index] for i in r.input_ids],
+                        "s": r.senders,
+                        "m": r.outputs_metadata,
+                        "r": r.receivers,
+                        "sigs": r.signatures,
+                    }
+                    for r in self.transfers
+                ],
+                "asig": self.auditor_signature,
+                "app": self.metadata.application,
+            }
+        )
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "TokenRequest":
+        d = loads(raw)
+        req = cls(anchor=d["anchor"])
+        for r in d["issues"]:
+            req.issues.append(
+                IssueRecord(
+                    action=r["a"], issuer=r["i"], outputs_metadata=r["m"],
+                    receivers=r["r"], signature=r["sig"],
+                )
+            )
+        for r in d["transfers"]:
+            req.transfers.append(
+                TransferRecord(
+                    action=r["a"],
+                    input_ids=[ID(t, i) for t, i in r["ids"]],
+                    senders=r["s"],
+                    outputs_metadata=r["m"],
+                    receivers=r["r"],
+                    signatures=r["sigs"],
+                )
+            )
+        req.auditor_signature = d["asig"]
+        req.metadata.application = d["app"]
+        return req
+
+    # ------------------------------------------------------------ helpers
+
+    def set_application_metadata(self, k: str, v: bytes) -> None:
+        self.metadata.application[k] = v
+
+    def application_metadata(self, k: str) -> Optional[bytes]:
+        return self.metadata.application.get(k)

@@ -1,0 +1,72 @@
+"""Request-level validator: actions + signatures + audit binding.
+
+Reference: `token/validator.go` + driver validators
+(`fabtoken/validator.go`, `zkatdlog/crypto/validator/validator.go`).
+Endorsers/committers run this against current ledger state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Tuple
+
+from .driver import Driver, ValidationError
+from .request import TokenRequest
+from ..drivers import identity
+from ..models.token import ID
+
+
+@dataclass
+class ValidationResult:
+    spent: List[ID] = field(default_factory=list)
+    # outputs in action order; each entry (action_kind, outputs)
+    outputs: List[Tuple[str, List[bytes]]] = field(default_factory=list)
+
+
+class RequestValidator:
+    def __init__(self, driver: Driver, auditor_identity: bytes = b""):
+        self.driver = driver
+        self.auditor = auditor_identity
+
+    def validate(self, request: TokenRequest, resolve_input: Callable[[ID], bytes]) -> ValidationResult:
+        result = ValidationResult()
+        payload = request.marshal_to_sign()
+
+        if self.auditor:
+            if not request.auditor_signature:
+                raise ValidationError("request is missing the auditor signature")
+            try:
+                identity.verify_signature(
+                    self.auditor, request.marshal_to_audit(), request.auditor_signature
+                )
+            except ValueError as e:
+                raise ValidationError(f"invalid auditor signature: {e}") from e
+
+        for rec in request.issues:
+            # the driver returns the issuer identity the ACTION names (after
+            # authorization checks); the record-level field is untrusted.
+            outputs, action_issuer = self.driver.validate_issue(rec.action)
+            if action_issuer:
+                if not rec.signature:
+                    raise ValidationError("issue is missing the issuer signature")
+                try:
+                    identity.verify_signature(action_issuer, payload, rec.signature)
+                except ValueError as e:
+                    raise ValidationError(f"invalid issuer signature: {e}") from e
+            result.outputs.append(("issue", outputs))
+
+        for rec in request.transfers:
+            spent, outputs = self.driver.validate_transfer(
+                rec.action, resolve_input, payload, rec.signatures
+            )
+            if spent != rec.input_ids:
+                raise ValidationError("transfer record ids do not match action")
+            result.spent.extend(spent)
+            result.outputs.append(("transfer", outputs))
+
+        if not request.issues and not request.transfers:
+            raise ValidationError("empty token request")
+        # no double spend within one request
+        if len(set(result.spent)) != len(result.spent):
+            raise ValidationError("request spends the same token twice")
+        return result

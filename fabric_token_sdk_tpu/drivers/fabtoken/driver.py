@@ -1,0 +1,170 @@
+"""fabtoken driver — plaintext tokens, signature-based validation.
+
+Reference: `token/core/fabtoken/*` (setup.go, issuer.go, sender.go,
+validator.go, validator_transfer.go). Tokens are stored in the clear;
+privacy comes only from identity management. Validation checks ownership
+signatures, type consistency, and value conservation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ...api.driver import Driver, IssueOutcome, TransferOutcome, ValidationError, vguard
+from ...crypto.serialization import dumps, loads
+from ...models.quantity import Quantity
+from ...models.token import ID, Owner, Token, UnspentToken
+from .. import identity
+
+MAX_PRECISION = 64
+
+
+@dataclass
+class FabTokenPublicParams:
+    """Reference `fabtoken/setup.go`: precision + authorized identities."""
+
+    label: str = "fabtoken"
+    quantity_precision: int = MAX_PRECISION
+    issuers: List[bytes] = field(default_factory=list)
+    auditor: bytes = b""
+
+    def token_data_hiding(self) -> bool:
+        return False
+
+    def graph_hiding(self) -> bool:
+        return False
+
+    def max_token_value(self) -> int:
+        return (1 << self.quantity_precision) - 1
+
+    def serialize(self) -> bytes:
+        return dumps(
+            {
+                "identifier": self.label,
+                "precision": self.quantity_precision,
+                "issuers": list(self.issuers),
+                "auditor": self.auditor,
+            }
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "FabTokenPublicParams":
+        d = loads(raw)
+        return cls(d["identifier"], d["precision"], d["issuers"], d["auditor"])
+
+    def add_issuer(self, ident: bytes) -> None:
+        self.issuers.append(ident)
+
+    def add_auditor(self, ident: bytes) -> None:
+        self.auditor = ident
+
+
+class FabTokenDriver(Driver):
+    name = "fabtoken"
+
+    def __init__(self, pp: Optional[FabTokenPublicParams] = None):
+        self.pp = pp or FabTokenPublicParams()
+
+    def public_params(self) -> FabTokenPublicParams:
+        return self.pp
+
+    def precision(self) -> int:
+        return self.pp.quantity_precision
+
+    # ------------------------------------------------------------ actions
+
+    def issue(self, issuer_identity, token_type, values, owners, anonymous=False) -> IssueOutcome:
+        if len(values) != len(owners):
+            raise ValueError("issue: values/owners length mismatch")
+        outputs = [
+            Token(Owner(owner), token_type, hex(v)).to_bytes()
+            for v, owner in zip(values, owners)
+        ]
+        action = dumps({"outputs": outputs, "issuer": issuer_identity})
+        # fabtoken metadata mirrors the clear outputs (reference: ppm.go)
+        return IssueOutcome(action_bytes=action, outputs=outputs, metadata=list(outputs))
+
+    def transfer(self, input_ids, input_tokens, input_metadata, token_type, values, owners) -> TransferOutcome:
+        if len(values) != len(owners):
+            raise ValueError("transfer: values/owners length mismatch")
+        outputs = [
+            Token(Owner(owner), token_type, hex(v)).to_bytes()
+            for v, owner in zip(values, owners)
+        ]
+        action = dumps(
+            {
+                "ids": [[i.tx_id, i.index] for i in input_ids],
+                "inputs": list(input_tokens),
+                "outputs": outputs,
+            }
+        )
+        return TransferOutcome(action_bytes=action, outputs=outputs, metadata=list(outputs))
+
+    # ------------------------------------------------------------ validate
+
+    @vguard
+    def validate_issue(self, action_bytes: bytes):
+        d = loads(action_bytes)
+        outputs = d["outputs"]
+        if not outputs:
+            raise ValidationError("issue must have at least one output")
+        issuer = d["issuer"]
+        if self.pp.issuers and issuer not in self.pp.issuers:
+            raise ValidationError("issuer is not authorized")
+        token_type = None
+        for raw in outputs:
+            t = Token.from_bytes(raw)
+            q = t.quantity_as(self.pp.quantity_precision)
+            if q.is_zero():
+                raise ValidationError("issue output with zero value")
+            if token_type is None:
+                token_type = t.type
+            elif t.type != token_type:
+                raise ValidationError("issue outputs with mixed types")
+        # fabtoken issues always require the action issuer's signature
+        return outputs, issuer
+
+    @vguard
+    def validate_transfer(self, action_bytes, resolve_input, signed_payload, signatures):
+        d = loads(action_bytes)
+        ids = [ID(t, i) for t, i in d["ids"]]
+        if not ids:
+            raise ValidationError("transfer must have at least one input")
+        ledger_inputs = [resolve_input(i) for i in ids]
+        inputs = [Token.from_bytes(raw) for raw in ledger_inputs]
+        outputs = [Token.from_bytes(raw) for raw in d["outputs"]]
+        # action must reference the same inputs it was signed over
+        if d["inputs"] != ledger_inputs:
+            raise ValidationError("transfer inputs do not match ledger state")
+        types = {t.type for t in inputs} | {t.type for t in outputs}
+        if len(types) != 1:
+            raise ValidationError(f"tokens must have the same type, got {sorted(types)}")
+        p = self.pp.quantity_precision
+        in_sum = sum(t.quantity_as(p).value for t in inputs)
+        out_sum = sum(t.quantity_as(p).value for t in outputs)
+        if in_sum != out_sum:
+            raise ValidationError(
+                f"transfer does not preserve value: in={in_sum} out={out_sum}"
+            )
+        if len(signatures) != len(inputs):
+            raise ValidationError("one signature per input owner required")
+        for t, sig in zip(inputs, signatures):
+            try:
+                identity.verify_signature(t.owner.raw, signed_payload, sig)
+            except ValueError as e:
+                raise ValidationError(f"invalid owner signature: {e}") from e
+        return ids, d["outputs"]
+
+    # ------------------------------------------------------------ tokens
+
+    def output_to_unspent(self, token_id, output_bytes, metadata_bytes=None) -> UnspentToken:
+        t = Token.from_bytes(output_bytes)
+        q = t.quantity_as(self.pp.quantity_precision)
+        return UnspentToken(token_id, t.owner, t.type, q.decimal())
+
+    def output_owner(self, output_bytes: bytes) -> bytes:
+        return Token.from_bytes(output_bytes).owner.raw
+
+    def verify_owner_signature(self, owner_identity, message, signature) -> None:
+        identity.verify_signature(owner_identity, message, signature)

@@ -1,0 +1,54 @@
+"""Identity encoding shared by drivers.
+
+Owner identities are tagged wire blobs so validators can dispatch:
+  pk    — long-term Schnorr public key (fabtoken owners, issuers, auditors)
+  nym   — pseudonym commitment (zkatdlog owners)
+  htlc  — hash-time-locked-contract script (interop; see services/interop)
+
+Reference: `token/core/identity/*`, `token/services/interop/htlc`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..crypto import hostmath as hm, nym as nym_mod, sign
+from ..crypto.serialization import dumps, loads
+
+
+def pk_identity(public: sign.PublicKey) -> bytes:
+    return dumps({"t": "pk", "pk": public.to_bytes()})
+
+
+def nym_identity(nym_point) -> bytes:
+    return dumps({"t": "nym", "nym": nym_point})
+
+
+def htlc_identity(script: dict) -> bytes:
+    return dumps({"t": "htlc", "script": script})
+
+
+def parse(raw: bytes) -> dict:
+    d = loads(raw)
+    if not isinstance(d, dict) or "t" not in d:
+        raise ValueError("invalid identity encoding")
+    return d
+
+
+def identity_kind(raw: bytes) -> str:
+    return parse(raw)["t"]
+
+
+def verify_signature(identity: bytes, message: bytes, signature: bytes,
+                     nym_params=None) -> None:
+    """Dispatch signature verification on the identity kind."""
+    d = parse(identity)
+    kind = d["t"]
+    if kind == "pk":
+        sign.PublicKey.from_bytes(d["pk"]).verify(message, signature)
+    elif kind == "nym":
+        if nym_params is None:
+            raise ValueError("nym verification requires nym parameters")
+        nym_mod.NymVerifier(d["nym"], list(nym_params)).verify(message, signature)
+    else:
+        raise ValueError(f"cannot verify signature for identity kind [{kind}]")

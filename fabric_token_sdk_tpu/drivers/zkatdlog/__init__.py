@@ -1,0 +1,1 @@
+from .driver import ZKATDLogDriver  # noqa: F401

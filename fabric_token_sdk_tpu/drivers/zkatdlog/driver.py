@@ -1,0 +1,172 @@
+"""zkatdlog driver — anonymous tokens with zero-knowledge validation.
+
+Reference: `token/core/zkatdlog/nogh/*` (service.go, issuer.go, sender.go,
+validator.go, deserializer.go). Tokens on the ledger are Pedersen
+commitments + owner identities (pseudonyms); actions carry ZK proofs
+(well-formedness + range) verified by every endorser.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ...api.driver import Driver, IssueOutcome, TransferOutcome, ValidationError, vguard
+from ...crypto import hostmath as hm, issue as issue_mod, transfer as transfer_mod
+from ...crypto.serialization import dumps, loads
+from ...crypto.setup import PublicParams
+from ...crypto.token import Metadata, Token as ZkToken, TokenDataWitness, token_in_the_clear, tokens_with_witness
+from ...models.token import ID, Owner, UnspentToken
+from .. import identity
+
+
+class ZKATDLogDriver(Driver):
+    name = "zkatdlog"
+
+    def __init__(self, pp: PublicParams):
+        self.pp = pp
+
+    def public_params(self) -> PublicParams:
+        return self.pp
+
+    def precision(self) -> int:
+        return self.pp.quantity_precision
+
+    # ------------------------------------------------------------ actions
+
+    def issue(self, issuer_identity, token_type, values, owners, anonymous=True,
+              rng=None) -> IssueOutcome:
+        if len(values) != len(owners):
+            raise ValueError("issue: values/owners length mismatch")
+        commitments, witnesses = tokens_with_witness(
+            list(values), token_type, self.pp.ped_params, rng
+        )
+        proof = issue_mod.IssueProver(
+            witnesses, commitments, anonymous, self.pp, rng
+        ).prove()
+        outputs = [
+            ZkToken(owner=o, data=c).to_bytes() for o, c in zip(owners, commitments)
+        ]
+        metadata = [
+            Metadata(token_type, w.value, w.bf, owner=o, issuer=issuer_identity).to_bytes()
+            for w, o in zip(witnesses, owners)
+        ]
+        action = dumps(
+            {
+                "outputs": outputs,
+                "proof": proof,
+                "anon": anonymous,
+                "issuer": b"" if anonymous else issuer_identity,
+            }
+        )
+        return IssueOutcome(action_bytes=action, outputs=outputs, metadata=metadata)
+
+    def transfer(self, input_ids, input_tokens, input_metadata, token_type, values,
+                 owners, rng=None) -> TransferOutcome:
+        if len(values) != len(owners):
+            raise ValueError("transfer: values/owners length mismatch")
+        in_tokens = [ZkToken.from_bytes(raw) for raw in input_tokens]
+        in_meta = [Metadata.from_bytes(raw) for raw in input_metadata]
+        in_witnesses = [
+            TokenDataWitness(m.token_type, m.value, m.bf) for m in in_meta
+        ]
+        for t, m in zip(in_tokens, in_meta):
+            # defensive: openings must match the commitments being spent
+            token_in_the_clear(t, m, self.pp.ped_params)
+        out_commitments, out_witnesses = tokens_with_witness(
+            list(values), token_type, self.pp.ped_params, rng
+        )
+        proof = transfer_mod.TransferProver(
+            in_witnesses,
+            out_witnesses,
+            [t.data for t in in_tokens],
+            out_commitments,
+            self.pp,
+            rng,
+        ).prove()
+        outputs = [
+            ZkToken(owner=o, data=c).to_bytes() for o, c in zip(owners, out_commitments)
+        ]
+        metadata = [
+            Metadata(token_type, w.value, w.bf, owner=o).to_bytes()
+            for w, o in zip(out_witnesses, owners)
+        ]
+        action = dumps(
+            {
+                "ids": [[i.tx_id, i.index] for i in input_ids],
+                "inputs": list(input_tokens),
+                "outputs": outputs,
+                "proof": proof,
+            }
+        )
+        return TransferOutcome(action_bytes=action, outputs=outputs, metadata=metadata)
+
+    # ------------------------------------------------------------ validate
+
+    @vguard
+    def validate_issue(self, action_bytes: bytes):
+        d = loads(action_bytes)
+        outputs = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+        if not outputs:
+            raise ValidationError("issue must have at least one output")
+        anonymous = d["anon"]
+        issuer = d["issuer"]
+        if not anonymous:
+            if self.pp.issuers and issuer not in self.pp.issuers:
+                raise ValidationError("issuer is not authorized")
+        elif issuer:
+            raise ValidationError("anonymous issue must not name an issuer")
+        try:
+            issue_mod.IssueVerifier(
+                [t.data for t in outputs], anonymous, self.pp
+            ).verify(d["proof"])
+        except ValueError as e:
+            raise ValidationError(f"invalid issue proof: {e}") from e
+        # non-anonymous issues require the named issuer's signature
+        return d["outputs"], issuer
+
+    @vguard
+    def validate_transfer(self, action_bytes, resolve_input, signed_payload, signatures):
+        d = loads(action_bytes)
+        ids = [ID(t, i) for t, i in d["ids"]]
+        if not ids:
+            raise ValidationError("transfer must have at least one input")
+        ledger_inputs = [resolve_input(i) for i in ids]
+        if d["inputs"] != ledger_inputs:
+            raise ValidationError("transfer inputs do not match ledger state")
+        in_tokens = [ZkToken.from_bytes(raw) for raw in ledger_inputs]
+        out_tokens = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+        try:
+            transfer_mod.TransferVerifier(
+                [t.data for t in in_tokens], [t.data for t in out_tokens], self.pp
+            ).verify(d["proof"])
+        except ValueError as e:
+            raise ValidationError(f"invalid transfer proof: {e}") from e
+        if len(signatures) != len(in_tokens):
+            raise ValidationError("one signature per input owner required")
+        for t, sig in zip(in_tokens, signatures):
+            try:
+                identity.verify_signature(
+                    t.owner, signed_payload, sig, nym_params=self.pp.nym_params
+                )
+            except ValueError as e:
+                raise ValidationError(f"invalid owner signature: {e}") from e
+        return ids, d["outputs"]
+
+    # ------------------------------------------------------------ tokens
+
+    def output_to_unspent(self, token_id, output_bytes, metadata_bytes=None) -> UnspentToken:
+        t = ZkToken.from_bytes(output_bytes)
+        if metadata_bytes is None:
+            raise ValueError("zkatdlog tokens need metadata to be opened")
+        m = Metadata.from_bytes(metadata_bytes)
+        token_type, value, owner = token_in_the_clear(t, m, self.pp.ped_params)
+        return UnspentToken(token_id, Owner(owner), token_type, str(value))
+
+    def output_owner(self, output_bytes: bytes) -> bytes:
+        return ZkToken.from_bytes(output_bytes).owner
+
+    def verify_owner_signature(self, owner_identity, message, signature) -> None:
+        identity.verify_signature(
+            owner_identity, message, signature, nym_params=self.pp.nym_params
+        )

@@ -1,0 +1,184 @@
+"""Driver tests: fabtoken + zkatdlog end-to-end issue/transfer/redeem."""
+import pytest
+
+from fabric_token_sdk_tpu.api.request import TokenRequest
+from fabric_token_sdk_tpu.api.tms import ManagementService
+from fabric_token_sdk_tpu.api.driver import ValidationError
+from fabric_token_sdk_tpu.api.wallet import IssuerWallet, OwnerWallet, WalletRegistry
+from fabric_token_sdk_tpu.crypto import sign
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+from fabric_token_sdk_tpu.models.token import ID
+
+
+def make_ledger(outputs_by_id):
+    def resolve(token_id):
+        if token_id not in outputs_by_id:
+            raise ValidationError(f"unknown input {token_id}")
+        return outputs_by_id[token_id]
+    return resolve
+
+
+@pytest.fixture(scope="module")
+def zk_pp():
+    return setup(base=4, exponent=2)
+
+
+def run_lifecycle(tms, alice, bob, issuer, anonymous):
+    # issue 12 to alice
+    req = tms.new_request("tx1")
+    alice_id = alice.recipient_identity()
+    tms.add_issue(req, issuer, "USD", [12], [alice_id], anonymous=anonymous)
+    tms.sign_issues(req)
+    v = tms.validator()
+    result = v.validate(req, make_ledger({}))
+    (kind, outputs), = result.outputs
+    assert kind == "issue" and len(outputs) == 1
+
+    ledger = {ID("tx1", 0): outputs[0]}
+    meta = req.issues[0].outputs_metadata
+
+    # transfer 12 -> 7 (bob) + 5 (alice change)
+    req2 = tms.new_request("tx2")
+    bob_id = bob.recipient_identity()
+    change_id = alice.recipient_identity()
+    tms.add_transfer(
+        req2, [ID("tx1", 0)], [ledger[ID("tx1", 0)]], meta, "USD", [7, 5],
+        [bob_id, change_id],
+    )
+    tms.sign_transfers(req2)
+    res2 = v.validate(req2, make_ledger(ledger))
+    assert res2.spent == [ID("tx1", 0)]
+    (_, outs2), = res2.outputs
+    ledger2 = {ID("tx2", 0): outs2[0], ID("tx2", 1): outs2[1]}
+
+    # bob's token opens correctly
+    ut = tms.driver.output_to_unspent(
+        ID("tx2", 0), outs2[0], req2.transfers[0].outputs_metadata[0]
+    )
+    assert ut.type == "USD" and ut.quantity == "7"
+
+    # redeem bob's 7 -> redeem 4 + change 3
+    req3 = tms.new_request("tx3")
+    tms.add_redeem(
+        req3, [ID("tx2", 0)], [outs2[0]], [req2.transfers[0].outputs_metadata[0]],
+        "USD", 4, 3, bob.recipient_identity(),
+    )
+    tms.sign_transfers(req3)
+    res3 = v.validate(req3, make_ledger(ledger2))
+    (_, outs3), = res3.outputs
+    assert tms.driver.output_owner(outs3[0]) == b""  # redeemed output
+
+    # double spend within one request is rejected
+    req4 = tms.new_request("tx4")
+    tms.add_transfer(req4, [ID("tx2", 1)], [outs2[1]],
+                     [req2.transfers[0].outputs_metadata[1]], "USD", [5],
+                     [bob.recipient_identity()])
+    tms.add_transfer(req4, [ID("tx2", 1)], [outs2[1]],
+                     [req2.transfers[0].outputs_metadata[1]], "USD", [5],
+                     [bob.recipient_identity()])
+    tms.sign_transfers(req4)
+    with pytest.raises(ValidationError):
+        v.validate(req4, make_ledger(ledger2))
+
+    # wrong signature is rejected
+    req5 = tms.new_request("tx5")
+    tms.add_transfer(req5, [ID("tx2", 1)], [outs2[1]],
+                     [req2.transfers[0].outputs_metadata[1]], "USD", [5],
+                     [bob.recipient_identity()])
+    tms.sign_transfers(req5)
+    req5.transfers[0].signatures[0] = req3.transfers[0].signatures[0]
+    with pytest.raises(ValidationError):
+        v.validate(req5, make_ledger(ledger2))
+
+
+def test_fabtoken_lifecycle(rng):
+    driver = FabTokenDriver(FabTokenPublicParams())
+    wallets = WalletRegistry()
+    alice = OwnerWallet("alice", anonymous=False, rng=rng)
+    bob = OwnerWallet("bob", anonymous=False, rng=rng)
+    issuer = IssuerWallet("issuer", sign.keygen(rng))
+    wallets.owners = {"alice": alice, "bob": bob}
+    wallets.issuers = {"issuer": issuer}
+    driver.pp.add_issuer(issuer.identity)
+    tms = ManagementService(driver, wallets, rng=rng)
+    run_lifecycle(tms, alice, bob, issuer, anonymous=False)
+
+
+def test_fabtoken_unauthorized_issuer(rng):
+    driver = FabTokenDriver(FabTokenPublicParams())
+    issuer = IssuerWallet("issuer", sign.keygen(rng))
+    rogue = IssuerWallet("rogue", sign.keygen(rng))
+    driver.pp.add_issuer(issuer.identity)
+    wallets = WalletRegistry()
+    wallets.issuers = {"rogue": rogue}
+    alice = OwnerWallet("alice", anonymous=False, rng=rng)
+    wallets.owners = {"alice": alice}
+    tms = ManagementService(driver, wallets, rng=rng)
+    req = tms.new_request("tx1")
+    tms.add_issue(req, rogue, "USD", [5], [alice.recipient_identity()], anonymous=False)
+    tms.sign_issues(req)
+    with pytest.raises(ValidationError):
+        tms.validator().validate(req, make_ledger({}))
+
+
+def test_zkatdlog_lifecycle(rng, zk_pp):
+    driver = ZKATDLogDriver(zk_pp)
+    wallets = WalletRegistry()
+    alice = OwnerWallet("alice", anonymous=True, nym_params=zk_pp.nym_params, rng=rng)
+    bob = OwnerWallet("bob", anonymous=True, nym_params=zk_pp.nym_params, rng=rng)
+    issuer = IssuerWallet("issuer", sign.keygen(rng))
+    wallets.owners = {"alice": alice, "bob": bob}
+    wallets.issuers = {"issuer": issuer}
+    tms = ManagementService(driver, wallets, rng=rng)
+    run_lifecycle(tms, alice, bob, issuer, anonymous=True)
+
+
+def test_zkatdlog_value_out_of_range(rng, zk_pp):
+    driver = ZKATDLogDriver(zk_pp)
+    issuer = IssuerWallet("issuer", sign.keygen(rng))
+    with pytest.raises(ValueError):
+        driver.issue(issuer.identity, "USD", [zk_pp.max_token_value() + 1], [b"x"])
+
+
+def test_issue_authorization_cannot_be_bypassed(rng):
+    """Record-level issuer swap / blanking must not bypass the action's
+    issuer signature requirement."""
+    driver = FabTokenDriver(FabTokenPublicParams())
+    issuer = IssuerWallet("issuer", sign.keygen(rng))
+    rogue = IssuerWallet("rogue", sign.keygen(rng))
+    driver.pp.add_issuer(issuer.identity)
+    wallets = WalletRegistry()
+    wallets.issuers = {"rogue": rogue}
+    alice = OwnerWallet("alice", anonymous=False, rng=rng)
+    wallets.owners = {"alice": alice}
+    tms = ManagementService(driver, wallets, rng=rng)
+    req = tms.new_request("tx1")
+    # forge: action names the AUTHORIZED issuer, record claims the rogue
+    outcome = driver.issue(issuer.identity, "USD", [5],
+                           [alice.recipient_identity()], anonymous=False)
+    from fabric_token_sdk_tpu.api.request import IssueRecord
+    rec = IssueRecord(action=outcome.action_bytes, issuer=rogue.identity,
+                      outputs_metadata=outcome.metadata)
+    req.issues.append(rec)
+    rec.signature = rogue.sign(req.marshal_to_sign(), rng)
+    with pytest.raises(ValidationError):
+        tms.validator().validate(req, make_ledger({}))
+    # blanking the record issuer must not skip the check either
+    rec.issuer = b""
+    rec.signature = b""
+    with pytest.raises(ValidationError):
+        tms.validator().validate(req, make_ledger({}))
+
+
+def test_malformed_action_bytes_rejected(rng):
+    driver = FabTokenDriver(FabTokenPublicParams())
+    with pytest.raises(ValidationError):
+        driver.validate_issue(b"garbage")
+    from fabric_token_sdk_tpu.crypto.serialization import dumps
+    with pytest.raises(ValidationError):
+        driver.validate_issue(dumps({"nope": 1}))
+    with pytest.raises(ValidationError):
+        driver.validate_transfer(dumps({"ids": [["a", 0]], "inputs": [], "outputs": []}),
+                                 make_ledger({}), b"", [])
