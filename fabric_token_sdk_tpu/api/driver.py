@@ -59,6 +59,7 @@ class Driver(abc.ABC):
     """A token driver (privacy model + crypto backend)."""
 
     name: str = ""
+    supports_anonymous_issue: bool = False
 
     # ------------------------------------------------------------ params
 

@@ -38,7 +38,9 @@ class ManagementService:
             action=outcome.action_bytes,
             # anonymous issues must not leak the issuer at the request level
             # either — the action already blanks it
-            issuer=b"" if anonymous and self.driver.name == "zkatdlog" else issuer.identity,
+            issuer=b""
+            if anonymous and self.driver.supports_anonymous_issue
+            else issuer.identity,
             outputs_metadata=outcome.metadata,
             receivers=list(owners),
         )

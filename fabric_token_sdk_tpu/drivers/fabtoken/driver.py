@@ -77,6 +77,8 @@ class FabTokenDriver(Driver):
     def issue(self, issuer_identity, token_type, values, owners, anonymous=False) -> IssueOutcome:
         if len(values) != len(owners):
             raise ValueError("issue: values/owners length mismatch")
+        for v in values:
+            Quantity(v, self.pp.quantity_precision)  # range check
         outputs = [
             Token(Owner(owner), token_type, hex(v)).to_bytes()
             for v, owner in zip(values, owners)
@@ -88,6 +90,8 @@ class FabTokenDriver(Driver):
     def transfer(self, input_ids, input_tokens, input_metadata, token_type, values, owners) -> TransferOutcome:
         if len(values) != len(owners):
             raise ValueError("transfer: values/owners length mismatch")
+        for v in values:
+            Quantity(v, self.pp.quantity_precision)  # range check
         outputs = [
             Token(Owner(owner), token_type, hex(v)).to_bytes()
             for v, owner in zip(values, owners)

@@ -22,6 +22,7 @@ from .. import identity
 
 class ZKATDLogDriver(Driver):
     name = "zkatdlog"
+    supports_anonymous_issue = True
 
     def __init__(self, pp: PublicParams):
         self.pp = pp

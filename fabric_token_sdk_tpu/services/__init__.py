@@ -1,0 +1,7 @@
+"""Token services runtime: network, vault, selector, ttx, ttxdb, auditor,
+owner, query, certifier, nfttx, interop.
+
+Reference: `token/services/*`. The reference rides fabric-smart-client on a
+Fabric network; ours is a self-contained runtime with an in-memory MVCC
+ledger (deterministic, race-detecting) that the same service APIs drive.
+"""

@@ -1,0 +1,1 @@
+from .auditor import AuditorService  # noqa: F401

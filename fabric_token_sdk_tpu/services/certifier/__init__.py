@@ -1,0 +1,1 @@
+from .certifier import CertificationService  # noqa: F401

@@ -1,0 +1,1 @@
+from .ledger import Block, FinalityEvent, Network, TxStatus  # noqa: F401

@@ -1,0 +1,123 @@
+"""In-memory token ledger with MVCC double-spend detection + finality events.
+
+Reference: `token/services/network/*` (fabric/orion backends + vault
+processor). Ours is a deterministic single-process ledger: an ordering
+queue serializes commits; each commit re-validates the request against
+current state, detects conflicts (already-spent inputs — the distributed
+"race"), applies writes atomically, and notifies finality listeners.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...api.driver import ValidationError
+from ...api.request import TokenRequest
+from ...api.validator import RequestValidator
+from ...models.token import ID
+from ...utils.tracing import tracer
+
+
+class TxStatus(Enum):
+    PENDING = "Pending"
+    VALID = "Valid"
+    INVALID = "Invalid"
+
+
+@dataclass
+class FinalityEvent:
+    tx_id: str
+    status: TxStatus
+    message: str = ""
+
+
+@dataclass
+class Block:
+    number: int
+    txs: List[str] = field(default_factory=list)
+
+
+class Network:
+    """Shared ledger + orderer for a set of parties."""
+
+    def __init__(self, validator: RequestValidator):
+        self.validator = validator
+        self._state: Dict[str, bytes] = {}  # token key -> output bytes
+        self._spent: set = set()  # token keys consumed (serials)
+        self._blocks: List[Block] = []
+        self._status: Dict[str, FinalityEvent] = {}
+        self._listeners: List[Callable[[FinalityEvent, TokenRequest], None]] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ queries
+
+    def resolve_input(self, token_id: ID) -> bytes:
+        key = token_id.key()
+        with self._lock:
+            if key in self._spent:
+                raise ValidationError(f"token {token_id} already spent")
+            if key not in self._state:
+                raise ValidationError(f"token {token_id} does not exist")
+            return self._state[key]
+
+    def exists(self, token_id: ID) -> bool:
+        key = token_id.key()
+        with self._lock:
+            return key in self._state and key not in self._spent
+
+    def status(self, tx_id: str) -> Optional[FinalityEvent]:
+        with self._lock:
+            return self._status.get(tx_id)
+
+    def height(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    # ------------------------------------------------------------ commit
+
+    def subscribe(self, listener: Callable[[FinalityEvent, TokenRequest], None]) -> None:
+        self._listeners.append(listener)
+
+    def submit(self, request_bytes: bytes) -> FinalityEvent:
+        """Order + validate + commit one token request (one tx per block).
+
+        Mirrors ordering -> endorser validation -> vault commit. Returns the
+        finality event (also pushed to subscribers).
+        """
+        request = TokenRequest.from_bytes(request_bytes)
+        tx_id = request.anchor
+        with tracer.span("network.submit", tx=tx_id):
+            with self._lock:
+                if tx_id in self._status:
+                    return self._status[tx_id]  # idempotent resubmission
+                try:
+                    result = self.validator.validate(request, self._resolve_locked)
+                    # MVCC conflict check happens inside _resolve_locked;
+                    # apply atomically
+                    for token_id in result.spent:
+                        self._spent.add(token_id.key())
+                        del self._state[token_id.key()]
+                    out_index = 0
+                    for _, outputs in result.outputs:
+                        for raw in outputs:
+                            self._state[ID(tx_id, out_index).key()] = raw
+                            out_index += 1
+                    event = FinalityEvent(tx_id, TxStatus.VALID)
+                except ValidationError as e:
+                    event = FinalityEvent(tx_id, TxStatus.INVALID, str(e))
+                self._status[tx_id] = event
+                self._blocks.append(Block(len(self._blocks), [tx_id]))
+            for listener in self._listeners:
+                listener(event, request)
+            return event
+
+    def _resolve_locked(self, token_id: ID) -> bytes:
+        key = token_id.key()
+        if key in self._spent:
+            raise ValidationError(f"token {token_id} already spent")
+        if key not in self._state:
+            raise ValidationError(f"token {token_id} does not exist")
+        return self._state[key]

@@ -1,0 +1,1 @@
+from .owner import OwnerService  # noqa: F401

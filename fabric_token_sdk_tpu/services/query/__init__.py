@@ -1,0 +1,1 @@
+from .query import QueryService  # noqa: F401

@@ -1,0 +1,1 @@
+from .selector import InsufficientFunds, Selector, SelectorManager  # noqa: F401

@@ -1,0 +1,116 @@
+"""Concurrent UTXO selector with per-token locks and retry/backoff.
+
+Reference: `token/services/selector/*` (manager.go, selector.go,
+inmemory locker). Multiple in-flight transactions compete for the same
+unspent tokens; the selector locks candidates, retries while tokens are
+busy, and raises typed errors on insufficient funds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...models.token import ID, UnspentToken
+from ..vault.vault import Vault
+
+
+class InsufficientFunds(Exception):
+    pass
+
+
+class SelectorTimeout(Exception):
+    pass
+
+
+class Locker:
+    def __init__(self):
+        self._locked: Dict[str, str] = {}  # token key -> tx id
+        self._mu = threading.Lock()
+
+    def try_lock(self, token_id: ID, tx_id: str) -> bool:
+        with self._mu:
+            if token_id.key() in self._locked:
+                return False
+            self._locked[token_id.key()] = tx_id
+            return True
+
+    def holder(self, token_id: ID) -> Optional[str]:
+        with self._mu:
+            return self._locked.get(token_id.key())
+
+    def unlock(self, token_id: ID) -> None:
+        with self._mu:
+            self._locked.pop(token_id.key(), None)
+
+    def unlock_by_tx(self, tx_id: str) -> None:
+        with self._mu:
+            for k in [k for k, v in self._locked.items() if v == tx_id]:
+                del self._locked[k]
+
+    def is_locked(self, token_id: ID) -> bool:
+        with self._mu:
+            return token_id.key() in self._locked
+
+
+class Selector:
+    def __init__(self, vault: Vault, locker: Locker, tx_id: str,
+                 retries: int = 10, backoff_s: float = 0.02):
+        self.vault = vault
+        self.locker = locker
+        self.tx_id = tx_id
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    def select(self, amount: int, token_type: str) -> Tuple[List[ID], int]:
+        """Lock unspent tokens of `token_type` totalling >= amount.
+
+        Returns (ids, total). Raises InsufficientFunds / SelectorTimeout.
+        """
+        for attempt in range(self.retries):
+            picked: List[ID] = []
+            total = 0
+            saw_busy = False
+            for ut in self.vault.unspent_tokens(token_type):
+                if total >= amount:
+                    break
+                if not self.locker.try_lock(ut.id, self.tx_id):
+                    # tokens this SAME tx already earmarked can never free up
+                    # before it completes: not retryable contention
+                    if self.locker.holder(ut.id) != self.tx_id:
+                        saw_busy = True
+                    continue
+                picked.append(ut.id)
+                total += int(ut.quantity)
+            if total >= amount:
+                return picked, total
+            # not enough: release and maybe retry (tokens may unlock)
+            for i in picked:
+                self.locker.unlock(i)
+            if not saw_busy:
+                raise InsufficientFunds(
+                    f"insufficient funds: need {amount} of [{token_type}]"
+                )
+            time.sleep(self.backoff_s * (attempt + 1))
+        raise SelectorTimeout(
+            f"token selection timed out: tokens busy for [{token_type}]"
+        )
+
+    def unselect(self, ids: List[ID]) -> None:
+        for i in ids:
+            self.locker.unlock(i)
+
+
+class SelectorManager:
+    """Per-party manager handing out tx-scoped selectors over one locker."""
+
+    def __init__(self, vault: Vault):
+        self.vault = vault
+        self.locker = Locker()
+
+    def new_selector(self, tx_id: str, **kw) -> Selector:
+        return Selector(self.vault, self.locker, tx_id, **kw)
+
+    def unlock_by_tx(self, tx_id: str) -> None:
+        self.locker.unlock_by_tx(tx_id)

@@ -1,0 +1,2 @@
+from .party import Party  # noqa: F401
+from .transaction import Transaction  # noqa: F401

@@ -1,0 +1,108 @@
+"""Token transaction lifecycle: assemble -> endorse -> order -> finality.
+
+Reference: `token/services/ttx/transaction.go`, `collect.go`, `endorse.go`,
+`ordering.go`, `finality.go`. One Transaction wraps one TokenRequest; the
+initiating party assembles actions (using its selector for inputs),
+collects signatures (owners, issuers, auditor), submits to ordering, and
+observes finality.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional, Sequence
+
+from ...api.driver import ValidationError
+from ...api.request import TokenRequest
+from ...models.token import ID
+from ..network.ledger import FinalityEvent, TxStatus
+from ..ttxdb.db import MovementDirection, TxType
+from .party import Party
+
+
+class Transaction:
+    def __init__(self, party: Party, tx_id: Optional[str] = None):
+        self.party = party
+        self.tx_id = tx_id or uuid.uuid4().hex
+        self.request: TokenRequest = party.tms.new_request(self.tx_id)
+        self._selected: List[ID] = []
+
+    # ------------------------------------------------------------ assembly
+
+    def issue(self, issuer_wallet_id: str, token_type: str, values: Sequence[int],
+              recipients: Sequence[bytes], anonymous: bool = True) -> None:
+        issuer = self.party.wallets.issuer_wallet(issuer_wallet_id)
+        anonymous = anonymous and self.party.driver.supports_anonymous_issue
+        self.party.tms.add_issue(
+            self.request, issuer, token_type, values, recipients, anonymous
+        )
+        self.party.db.add_transaction(
+            self.tx_id, TxType.ISSUE, issuer_wallet_id, "", token_type, sum(values)
+        )
+
+    def transfer(self, owner_wallet_id: str, token_type: str,
+                 values: Sequence[int], recipients: Sequence[bytes]) -> None:
+        """Select inputs, build the transfer (+change), record movements."""
+        amount = sum(values)
+        selector = self.party.selectors.new_selector(self.tx_id)
+        ids, total = selector.select(amount, token_type)
+        self._selected.extend(ids)
+        outputs_values = list(values)
+        out_owners = list(recipients)
+        if total > amount:
+            # change back to the sender
+            wallet = self.party.wallets.owner_wallet(owner_wallet_id)
+            outputs_values.append(total - amount)
+            out_owners.append(wallet.recipient_identity())
+        tokens, metas = self.party.vault.get_many(ids)
+        self.party.tms.add_transfer(
+            self.request, ids, tokens, metas, token_type, outputs_values, out_owners
+        )
+        self.party.db.add_transaction(
+            self.tx_id, TxType.TRANSFER, owner_wallet_id, "", token_type, amount
+        )
+        self.party.db.add_movement(
+            self.tx_id, owner_wallet_id, token_type, amount, MovementDirection.SENT
+        )
+
+    def redeem(self, owner_wallet_id: str, token_type: str, value: int) -> None:
+        selector = self.party.selectors.new_selector(self.tx_id)
+        ids, total = selector.select(value, token_type)
+        self._selected.extend(ids)
+        wallet = self.party.wallets.owner_wallet(owner_wallet_id)
+        tokens, metas = self.party.vault.get_many(ids)
+        self.party.tms.add_redeem(
+            self.request, ids, tokens, metas, token_type, value,
+            total - value, wallet.recipient_identity() if total > value else b"",
+        )
+        self.party.db.add_transaction(
+            self.tx_id, TxType.REDEEM, owner_wallet_id, "", token_type, value
+        )
+        self.party.db.add_movement(
+            self.tx_id, owner_wallet_id, token_type, value, MovementDirection.SENT
+        )
+
+    # ------------------------------------------------------------ endorse
+
+    def collect_endorsements(self, auditor=None) -> None:
+        """Owners sign, issuers sign, auditor audits + signs.
+
+        Reference ttx/collect.go + auditor.go: the request is audited
+        BEFORE ordering; the auditor signature covers actions + metadata.
+        """
+        self.party.tms.sign_transfers(self.request)
+        self.party.tms.sign_issues(self.request)
+        if auditor is not None:
+            auditor.audit(self.request)
+
+    # ------------------------------------------------------------ ordering
+
+    def submit(self) -> FinalityEvent:
+        event = self.party.network.submit(self.request.to_bytes())
+        if event.status != TxStatus.VALID:
+            self.party.selectors.unlock_by_tx(self.tx_id)
+            raise ValidationError(f"tx {self.tx_id} rejected: {event.message}")
+        return event
+
+    def abort(self) -> None:
+        self.party.selectors.unlock_by_tx(self.tx_id)

@@ -1,0 +1,1 @@
+from .db import MovementDirection, TransactionDB, TxType  # noqa: F401

@@ -1,0 +1,1 @@
+from .vault import Vault  # noqa: F401

@@ -1,0 +1,129 @@
+"""Per-party token vault: owned unspent tokens + certification store.
+
+Reference: `token/services/vault/*` (token store, query engine,
+certification) and `token/vault.go`. The vault subscribes to network
+finality events; on every valid tx it deletes spent tokens and stores the
+outputs owned by this party's wallets (openings arrive via the request
+metadata the party already holds off-chain).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ...api.driver import Driver
+from ...api.request import TokenRequest
+from ...models.quantity import Quantity
+from ...models.token import ID, UnspentToken
+from ..network.ledger import FinalityEvent, TxStatus
+
+
+@dataclass
+class StoredToken:
+    id: ID
+    output: bytes
+    metadata: Optional[bytes]
+    decoded: Optional[UnspentToken] = None  # cached opening (immutable)
+
+
+class Vault:
+    def __init__(self, driver: Driver, owns_identity: Callable[[bytes], bool]):
+        self.driver = driver
+        self.owns_identity = owns_identity
+        self._tokens: Dict[str, StoredToken] = {}
+        self._certified: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ process
+
+    def on_finality(self, event: FinalityEvent, request: TokenRequest) -> None:
+        """Network finality listener (reference: vault processor)."""
+        if event.status != TxStatus.VALID:
+            return
+        tx_id = event.tx_id
+        with self._lock:
+            # delete spent
+            for rec in request.transfers:
+                for token_id in rec.input_ids:
+                    self._tokens.pop(token_id.key(), None)
+            # store owned outputs; output indices are global across actions
+            out_index = 0
+            for rec in request.issues:
+                metas = rec.outputs_metadata
+                outputs = self._action_outputs(rec.action)
+                for raw, meta in zip(outputs, metas):
+                    self._maybe_store(tx_id, out_index, raw, meta)
+                    out_index += 1
+            for rec in request.transfers:
+                metas = rec.outputs_metadata
+                outputs = self._action_outputs(rec.action)
+                for raw, meta in zip(outputs, metas):
+                    self._maybe_store(tx_id, out_index, raw, meta)
+                    out_index += 1
+
+    def _action_outputs(self, action_bytes: bytes) -> List[bytes]:
+        from ...crypto.serialization import loads
+
+        return loads(action_bytes)["outputs"]
+
+    def _maybe_store(self, tx_id: str, index: int, output: bytes, metadata: Optional[bytes]) -> None:
+        owner = self.driver.output_owner(output)
+        if not owner or not self.owns_identity(owner):
+            return
+        token_id = ID(tx_id, index)
+        try:
+            decoded = self.driver.output_to_unspent(token_id, output, metadata)
+        except Exception as e:
+            # metadata missing/mismatched: keep raw bytes, flag loudly —
+            # the token is unusable until re-delivered
+            from ...utils.tracing import logger
+
+            logger.warning("vault: cannot open owned token %s: %s", token_id, e)
+            decoded = None
+        self._tokens[token_id.key()] = StoredToken(token_id, output, metadata, decoded)
+
+    # ------------------------------------------------------------ queries
+
+    def unspent_tokens(self, token_type: Optional[str] = None) -> List[UnspentToken]:
+        with self._lock:
+            stored = list(self._tokens.values())
+        return [
+            st.decoded
+            for st in stored
+            if st.decoded is not None
+            and (token_type is None or st.decoded.type == token_type)
+        ]
+
+    def get(self, token_id: ID) -> Optional[StoredToken]:
+        with self._lock:
+            return self._tokens.get(token_id.key())
+
+    def get_many(self, ids) -> Tuple[List[bytes], List[bytes]]:
+        outputs, metas = [], []
+        with self._lock:
+            for i in ids:
+                st = self._tokens.get(i.key())
+                if st is None:
+                    raise KeyError(f"token {i} not in vault")
+                outputs.append(st.output)
+                metas.append(st.metadata)
+        return outputs, metas
+
+    def balance(self, token_type: str) -> int:
+        return sum(int(t.quantity) for t in self.unspent_tokens(token_type))
+
+    def token_ids(self) -> List[ID]:
+        with self._lock:
+            return [st.id for st in self._tokens.values()]
+
+    # ------------------------------------------------------------ certify
+
+    def store_certification(self, token_id: ID, cert: bytes) -> None:
+        with self._lock:
+            self._certified[token_id.key()] = cert
+
+    def certification(self, token_id: ID) -> Optional[bytes]:
+        with self._lock:
+            return self._certified.get(token_id.key())

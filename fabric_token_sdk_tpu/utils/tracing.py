@@ -1,0 +1,70 @@
+"""Lightweight span tracing + counters (reference: fabric-smart-client's
+flogging/metrics used throughout token/services)."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("fts_tpu")
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: Optional[float] = None
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.monotonic()) - self.start
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spans: List[Span] = []
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.enabled = True
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            yield None
+            return
+        s = Span(name, time.monotonic(), attrs=attrs)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+            with self._lock:
+                self.spans.append(s)
+                if len(self.spans) > 10000:
+                    del self.spans[:5000]
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def summary(self) -> Dict[str, dict]:
+        with self._lock:
+            agg: Dict[str, dict] = {}
+            for s in self.spans:
+                a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0})
+                a["count"] += 1
+                a["total_s"] += s.duration
+            return agg
+
+    def reset(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.counters.clear()
+
+
+tracer = Tracer()
