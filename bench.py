@@ -1,0 +1,116 @@
+"""Headline benchmark: zkatdlog transfer-proof verification throughput.
+
+Prints ONE JSON line:
+  {"metric": "zkatdlog_transfer_verify_throughput", "value": N,
+   "unit": "tx/s", "vs_baseline": N / 133.0, ...}
+
+Baseline (BASELINE.md): reference Go implementation, 2-in/2-out transfers
+with base=16 exponent=2 range proofs ~= 133 tx/s per x86 core.
+
+Runs on whatever accelerator the ambient JAX platform provides (the axon
+TPU under the driver; CPU fallback if the tunnel is down). Proof
+generation happens on the host; the measured quantity is block
+verification: batched WF + range-equality + membership(4 pairing products
+each) kernels plus host Fiat-Shamir re-hashing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _platform_guard() -> str:
+    """Probe device init in a watchdog thread; fall back to CPU if the
+    remote TPU tunnel hangs."""
+    result = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+            result["platform"] = result["devices"][0].platform
+        except Exception as e:  # pragma: no cover
+            result["error"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=float(os.environ.get("FTS_BENCH_INIT_TIMEOUT", "120")))
+    if "platform" in result:
+        return result["platform"]
+    # tunnel hang/failure: re-exec on CPU
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_FTS_BENCH_REEXEC"] = "1"
+    if not os.environ.get("_FTS_BENCH_REEXEC"):
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    return "cpu"
+
+
+def main() -> None:
+    platform = _platform_guard()
+    import random
+
+    import numpy as np
+
+    from fabric_token_sdk_tpu.crypto import batch as batch_mod, transfer, token as tok
+    from fabric_token_sdk_tpu.crypto.setup import setup
+
+    B = int(os.environ.get("FTS_BENCH_BATCH", "32"))
+    base = 16
+    exponent = 2
+    rng = random.Random(1234)
+    t0 = time.time()
+    pp = setup(base=base, exponent=exponent, rng=rng)
+    setup_s = time.time() - t0
+
+    # build B two-in/two-out transfers (host proving)
+    t0 = time.time()
+    txs = []
+    for i in range(B):
+        in_toks, in_w = tok.tokens_with_witness([100, 55], "USD", pp.ped_params, rng)
+        out_toks, out_w = tok.tokens_with_witness([120, 35], "USD", pp.ped_params, rng)
+        proof = transfer.TransferProver(in_w, out_w, in_toks, out_toks, pp, rng).prove()
+        txs.append((in_toks, out_toks, proof))
+    gen_s = time.time() - t0
+
+    verifier = batch_mod.BatchedTransferVerifier(pp)
+    # warmup (compiles device programs)
+    t0 = time.time()
+    ok = verifier.verify(txs)
+    warm_s = time.time() - t0
+    assert bool(np.all(ok)), "benchmark proofs failed to verify"
+
+    # timed runs
+    runs = int(os.environ.get("FTS_BENCH_RUNS", "3"))
+    t0 = time.time()
+    for _ in range(runs):
+        ok = verifier.verify(txs)
+    elapsed = time.time() - t0
+    rate = B * runs / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "zkatdlog_transfer_verify_throughput",
+                "value": round(rate, 2),
+                "unit": "tx/s",
+                "vs_baseline": round(rate / 133.0, 3),
+                "platform": platform,
+                "batch": B,
+                "runs": runs,
+                "warmup_s": round(warm_s, 1),
+                "provegen_s": round(gen_s, 1),
+                "setup_s": round(setup_s, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,8 @@ def _enc(v: Any) -> Any:
         return v
     if isinstance(v, int):
         return hex(v)
+    if isinstance(v, float):
+        return {"f": repr(v)}
     if isinstance(v, bytes):
         return {"b": v.hex()}
     if isinstance(v, tuple):  # G1/G2 points or fp2 pairs, nested ints
@@ -74,6 +76,8 @@ def _dec(v: Any) -> Any:
             return bytes.fromhex(v["b"])
         if set(v) == {"s"}:
             return v["s"]
+        if set(v) == {"f"}:
+            return float(v["f"])
         if set(v) == {"t"}:
             return tuple(_dec(x) for x in v["t"])
         return {k: _dec(x) for k, x in v.items()}

@@ -50,5 +50,11 @@ def verify_signature(identity: bytes, message: bytes, signature: bytes,
         if nym_params is None:
             raise ValueError("nym verification requires nym parameters")
         nym_mod.NymVerifier(d["nym"], list(nym_params)).verify(message, signature)
+    elif kind == "htlc":
+        # hash-time-locked script: claim/reclaim rules (lazy import to
+        # avoid a services <-> drivers cycle)
+        from ..services.interop.htlc import verify_htlc_spend
+
+        verify_htlc_spend(identity, message, signature, nym_params)
     else:
         raise ValueError(f"cannot verify signature for identity kind [{kind}]")

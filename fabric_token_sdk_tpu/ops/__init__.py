@@ -16,5 +16,20 @@ assembly; we target the VPU/MXU instead):
   matrices — dense matmuls that ride the MXU.
 """
 
+import os as _os
+
+import jax as _jax
+
+# Persistent compilation cache: the pairing/Miller programs are large and
+# XLA (esp. :CPU) compiles them slowly; cache them across processes.
+_cache_dir = _os.environ.get(
+    "FTS_TPU_JAX_CACHE", _os.path.expanduser("~/.cache/fts_tpu_jax")
+)
+try:
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # older jax without the knobs
+    pass
+
 from . import limbs  # noqa: F401
 from .field import FP, FR, FieldSpec  # noqa: F401

@@ -31,7 +31,9 @@ def is_infinity(p):
 
 
 def neg(p):
-    return p.at[..., 1, :].set(FP.neg(p[..., 1, :]))
+    return jnp.stack(
+        [p[..., 0, :], FP.neg(p[..., 1, :]), p[..., 2, :]], axis=-2
+    )
 
 
 @jax.jit

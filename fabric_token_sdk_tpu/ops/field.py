@@ -38,56 +38,95 @@ class FieldSpec:
     modulus: int
     nlimbs: int = lb.NLIMBS
     p_limbs: np.ndarray = field(init=False, repr=False)
+    twop_limbs: np.ndarray = field(init=False, repr=False)
     pprime_limbs: np.ndarray = field(init=False, repr=False)  # -p^-1 mod R
     r2_limbs: np.ndarray = field(init=False, repr=False)  # R^2 mod p
     one_mont: np.ndarray = field(init=False, repr=False)  # R mod p
 
     def __post_init__(self):
         R = 1 << (lb.RADIX_BITS * self.nlimbs)
-        if self.modulus >= R or self.modulus % 2 == 0:
-            raise ValueError("modulus must be odd and fit the limb width")
+        # the redundant-domain REDC design needs 4p <= R so that products of
+        # two [0, 2p) elements satisfy T < pR and outputs stay in [0, 2p)
+        if 4 * self.modulus > R or self.modulus % 2 == 0:
+            raise ValueError("modulus must be odd with 4p within the limb width")
         object.__setattr__(self, "p_limbs", lb.int_to_limbs(self.modulus, self.nlimbs))
+        object.__setattr__(self, "twop_limbs", lb.int_to_limbs(2 * self.modulus, self.nlimbs))
         pprime = (-pow(self.modulus, -1, R)) % R
         object.__setattr__(self, "pprime_limbs", lb.int_to_limbs(pprime, self.nlimbs))
         object.__setattr__(self, "r2_limbs", lb.int_to_limbs(R * R % self.modulus, self.nlimbs))
         object.__setattr__(self, "one_mont", lb.int_to_limbs(R % self.modulus, self.nlimbs))
 
     # ------------------------------------------------------------- reduce
+    #
+    # Elements live in the REDUNDANT domain [0, 2p): REDC maps products of
+    # two such elements back into it (4p^2 < p*2^W), so `mul` needs no
+    # final subtraction, and add/sub need only a single select-subtract
+    # driven by the top limb of a complement addition — no lexicographic
+    # comparisons anywhere on the hot path. Canonical [0, p) form is
+    # produced lazily (`canon`) for equality/decoding.
+
+    def _select_sub(self, x, m_limbs: np.ndarray, passes: int):
+        """Given digits of x (value < 2^W + range), return x - m if
+        x >= m else x, via x + comp(m) + 1 over W+1 limbs: the top limb
+        is 1 exactly when x >= m."""
+        # numpy constant: comp(m) with the +1 folded into limb 0, plus a
+        # zero top limb (branch- and scatter-free)
+        compp1 = np.concatenate([lb.MASK - m_limbs, [0]]).astype(np.int32)
+        compp1[0] += 1
+        s = jnp.concatenate([x, jnp.zeros_like(x[..., :1])], axis=-1) + compp1
+        s = lb.normalize_fixed(s, passes)
+        ge = s[..., self.nlimbs :][..., 0] > 0
+        return jnp.where(ge[..., None], s[..., : self.nlimbs], lb.normalize_fixed(x, passes))
 
     @_opjit
     def cond_sub_p(self, x):
-        """x in [0, 2p) -> x mod p."""
-        ge = lb.compare_ge(x, self.p_limbs)
-        d = jnp.where(ge[..., None], x - self.p_limbs, x)
-        return lb.normalize(d)
+        """Redundant [0, 2p) -> canonical [0, p)."""
+        return self._select_sub(x, self.p_limbs, 1)
+
+    def canon(self, x):
+        return self.cond_sub_p(x)
 
     # ------------------------------------------------------------- ring ops
 
     @_opjit
     def add(self, x, y):
-        return self.cond_sub_p(lb.normalize(x + y))
+        """[0,2p) x [0,2p) -> [0,2p): add then select-subtract 2p."""
+        return self._select_sub(x + y, self.twop_limbs, 2)
 
     @_opjit
     def sub(self, x, y):
-        return self.cond_sub_p(lb.normalize(x + self.p_limbs - y))
+        """x - y in [0, 2p), borrow-free.
+
+        s = x + comp(y) + 1 over W+1 limbs has value x - y + 2^W; its top
+        limb says whether x >= y. If so the low limbs ARE x - y; otherwise
+        add 2p to them (total then overflows 2^W exactly once)."""
+        comp_y1 = (lb.MASK - y) + np.concatenate([[1], np.zeros(self.nlimbs - 1, np.int32)]).astype(np.int32)
+        s = jnp.concatenate(
+            [x + comp_y1, jnp.zeros_like(x[..., :1])], axis=-1
+        )  # digits <= 511
+        s = lb.normalize_fixed(s, 1)
+        x_ge_y = s[..., self.nlimbs :][..., 0] > 0
+        s_low = s[..., : self.nlimbs]
+        t = jnp.concatenate(
+            [s_low + self.twop_limbs, jnp.zeros_like(x[..., :1])], axis=-1
+        )
+        t_low = lb.normalize_fixed(t, 1)[..., : self.nlimbs]
+        return jnp.where(x_ge_y[..., None], s_low, t_low)
 
     @_opjit
     def neg(self, x):
-        return self.cond_sub_p(lb.normalize(self.p_limbs - x + jnp.zeros_like(x)))
+        return self.sub(jnp.zeros_like(x), x)
 
     @_opjit
     def mul(self, x, y):
-        """Montgomery product: REDC(x*y)."""
+        """Montgomery product: REDC(x*y); stays in [0, 2p)."""
         n = self.nlimbs
-        t = lb.mul_full(x, y)  # (..., 2n+1)
+        t = lb.mul_full(x, y)  # (..., 2n+1) canonical digits
         m = lb.mul_low(t[..., :n], self.pprime_limbs, keep=n)
         mp = lb.mul_full(m, self.p_limbs)  # (..., 2n+1)
-        width = 2 * n + 2
-        acc = jnp.zeros(t.shape[:-1] + (width,), dtype=jnp.int32)
-        acc = acc.at[..., : 2 * n + 1].add(t)
-        acc = acc.at[..., : 2 * n + 1].add(mp)
-        res = lb.normalize(acc)[..., n : 2 * n]
-        return self.cond_sub_p(res)
+        pad = [(0, 0)] * (t.ndim - 1) + [(0, 1)]
+        acc = jnp.pad(t, pad) + jnp.pad(mp, pad)  # digits <= 510
+        return lb.normalize_fixed(acc, 1)[..., n : 2 * n]
 
     @_opjit
     def sqr(self, x):
@@ -116,19 +155,18 @@ class FieldSpec:
 
     @_opjit(static=(2,))
     def mul_small(self, x, k: int):
-        """x * k for small non-negative python int k (k < 2^15)."""
-        return self.cond_sub_p_loop(lb.normalize(x * jnp.int32(k)))
-
-    def cond_sub_p_loop(self, x):
-        """x in [0, k*p) for small k -> x mod p (repeated conditional subtract)."""
-
-        def cond(v):
-            return jnp.any(lb.compare_ge(v, self.p_limbs))
-
-        def body(v):
-            return self.cond_sub_p(v)
-
-        return lax.while_loop(cond, body, x)
+        """x * k for a small static non-negative int, via double-and-add —
+        every intermediate stays inside the [0, 2p) domain."""
+        if k < 0:
+            raise ValueError("mul_small: k must be non-negative")
+        if k == 0:
+            return jnp.zeros_like(x)
+        acc = None
+        for bit in bin(k)[2:]:
+            acc = self.add(acc, acc) if acc is not None else None
+            if bit == "1":
+                acc = x if acc is None else self.add(acc, x)
+        return acc
 
     # ------------------------------------------------------------- domain
 
@@ -138,8 +176,9 @@ class FieldSpec:
 
     @_opjit
     def from_mont(self, x):
-        one = jnp.zeros_like(x).at[..., 0].set(1)
-        return self.mul(x, one)
+        one = np.zeros(self.nlimbs, dtype=np.int32)
+        one[0] = 1
+        return self.mul(x, jnp.broadcast_to(jnp.asarray(one), x.shape))
 
     # ------------------------------------------------------------- host I/O
 
@@ -153,8 +192,8 @@ class FieldSpec:
         return self.encode([v])[0]
 
     def decode(self, x) -> list:
-        """Montgomery limb tensor -> host ints."""
-        return lb.batch_limbs_to_ints(np.asarray(self.from_mont(x)))
+        """Montgomery limb tensor -> host ints (canonicalized)."""
+        return lb.batch_limbs_to_ints(np.asarray(self.cond_sub_p(self.from_mont(x))))
 
     def decode_scalar(self, x) -> int:
         return self.decode(x[None, ...])[0]
@@ -169,11 +208,15 @@ class FieldSpec:
             jnp.asarray(self.one_mont), tuple(shape) + (self.nlimbs,)
         ).astype(jnp.int32)
 
+    @_opjit
     def is_zero(self, x):
-        return lb.is_zero(x)
+        """Zero test in the redundant domain (0 and p both represent 0)."""
+        return lb.is_zero(self.cond_sub_p(x))
 
+    @_opjit
     def eq(self, x, y):
-        return jnp.all(x == y, axis=-1)
+        """Equality in the redundant domain: canonicalize then compare."""
+        return jnp.all(self.cond_sub_p(x) == self.cond_sub_p(y), axis=-1)
 
 
 @functools.lru_cache(maxsize=None)

@@ -75,15 +75,44 @@ def carry_pass(x):
 def normalize(x):
     """Propagate carries until every limb is canonical (in [0, RADIX)).
 
-    The represented TOTAL must be non-negative and fit the vector width;
-    otherwise this loops forever (callers: add the modulus before any
-    subtraction, size product accumulators at 2*NLIMBS+1).
+    General data-dependent form (while_loop) — used only off the hot path.
+    The represented TOTAL must be non-negative and fit the vector width.
     """
 
     def cond(v):
         return jnp.any((v < 0) | (v > MASK))
 
     return jax.lax.while_loop(cond, carry_pass, x)
+
+
+def _roll_up(a, s: int):
+    """a[i - s] with zeros shifted in (along the limb axis)."""
+    pad = jnp.zeros_like(a[..., :s])
+    return jnp.concatenate([pad, a[..., :-s]], axis=-1)
+
+
+def normalize_fixed(x, passes: int):
+    """Branch-free carry normalization for NON-NEGATIVE digit vectors.
+
+    `passes` plain carry passes must bring every digit into [0, RADIX]
+    (bound: B -> MASK + (B >> RADIX_BITS)); the residual +1 carries are then
+    resolved exactly with a Kogge-Stone carry-lookahead (log-depth, no
+    data-dependent control flow — the TPU-friendly form).
+    """
+    for _ in range(passes):
+        x = carry_pass(x)
+    # digits now in [0, RADIX]; resolve unit carries via (generate, propagate)
+    g = (x > MASK).astype(jnp.int32)
+    p = (x == MASK).astype(jnp.int32)
+    n = x.shape[-1]
+    s = 1
+    while s < n:
+        g = g | (p & _roll_up(g, s))
+        p = p & _roll_up(p, s)
+        s <<= 1
+    c_in = _roll_up(g, 1)
+    t = x + c_in
+    return t - ((t > MASK).astype(jnp.int32) << RADIX_BITS)
 
 
 # ---------------------------------------------------------------- add / cmp
@@ -128,14 +157,21 @@ def _conv_matrix(nx: int, ny: int):
 def mul_full(x, y):
     """Full product of two limb vectors -> nx+ny+1 canonical limbs.
 
-    Outer products are < 2^16 and each column accumulates < 2*NLIMBS
-    of them: everything stays inside int32.
+    Outer products are < 2^16 and each column sum < 2^23: all values are
+    exactly representable in float32, so the column contraction runs as an
+    f32 matmul (CPU: real GEMM; TPU: MXU with HIGHEST precision) and is
+    cast back to int32 losslessly. Fully branch-free.
     """
     nx, ny = x.shape[-1], y.shape[-1]
-    prod = x[..., :, None] * y[..., None, :]
-    flat = prod.reshape(prod.shape[:-2] + (nx * ny,))
-    acc = flat @ _conv_matrix(nx, ny)
-    return normalize(acc)
+    prod = x[..., :, None] * y[..., None, :]  # int32, exact (< 2^16)
+    flat = prod.reshape(prod.shape[:-2] + (nx * ny,)).astype(jnp.float32)
+    acc = jax.lax.dot_general(
+        flat,
+        _conv_matrix(nx, ny).astype(np.float32),
+        (((flat.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return normalize_fixed(acc.astype(jnp.int32), 3)
 
 
 def mul_low(x, y, keep=None):

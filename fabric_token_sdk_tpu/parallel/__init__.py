@@ -1,0 +1,6 @@
+from .sharding import (  # noqa: F401
+    make_mesh,
+    shard_rows,
+    sharded_pairing_product,
+    sharded_wf_verify_kernel,
+)

@@ -1,0 +1,93 @@
+"""Mesh sharding of batched proof verification.
+
+Scale-out model (SURVEY §2, TPU-scale subsystems): proof batches are
+data-parallel over a `dp` mesh axis; the K legs of pairing products can
+additionally shard over an `mp` axis, combined with an `all_gather`
+collective before the shared final exponentiation — the ICI-friendly
+layout (batch stays put, only 12-coefficient GT values move).
+
+The reference scales by adding Fabric endorser processes; here one program
+spans all chips of a slice via `jax.sharding.Mesh` + `shard_map`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import curve as cv, pairing as pr, tower as tw
+from ..ops.field import FP
+
+
+def make_mesh(n_devices: Optional[int] = None, mp: int = 1) -> Mesh:
+    """Mesh of shape (dp, mp) over the first n_devices devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n % mp:
+        raise ValueError("mesh: n_devices must be divisible by mp")
+    arr = np.array(devs[:n]).reshape(n // mp, mp)
+    return Mesh(arr, ("dp", "mp"))
+
+
+def shard_rows(arr, mesh: Mesh):
+    """Place an array with its leading (batch) axis split over dp."""
+    spec = P("dp") if mesh.shape["mp"] == 1 else P("dp", "mp")
+    ndim = np.asarray(arr).ndim
+    full = P(*(spec[: min(len(spec), 1)] + (None,) * (ndim - 1)))
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, full))
+
+
+def sharded_pairing_product(Ps, Qs, mesh: Mesh):
+    """prod_k e(P_k, Q_k) per batch row, dp over rows and mp over the K
+    pairing legs; Miller values all_gather over mp, one final exp.
+
+    Ps: (B, K, 2, L), Qs: (B, K, 2, 2, L); B % dp == 0, K % mp == 0.
+    """
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("dp", "mp"), P("dp", "mp")),
+        out_specs=P("dp"),
+        check_rep=False,
+    )
+    def run(ps, qs):
+        f = pr.miller_loop(ps, qs)  # (b_local, k_local, 6, 2, L)
+        f = jax.lax.all_gather(f, "mp", axis=2, tiled=False)
+        # f: (b_local, k_local, mp, 6, 2, L) -> combine all K legs locally
+        k_total = f.shape[1] * f.shape[2]
+        f = f.reshape(f.shape[0], k_total, 6, 2, f.shape[-1])
+        while f.shape[1] > 1:
+            half = f.shape[1] // 2
+            rest = f[:, 2 * half :]
+            f = tw.fp12_mul(f[:, :half], f[:, half : 2 * half])
+            if rest.shape[1]:
+                f = jnp.concatenate([f, rest], axis=1)
+        return pr.final_exp(f[:, 0])
+
+    return run(Ps, Qs)
+
+
+def sharded_wf_verify_kernel(table: cv.FixedBaseTable, resp, stmts, chals,
+                             mesh: Mesh):
+    """Batch-parallel Schnorr commitment reconstruction over dp."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp")),
+        out_specs=P("dp"),
+        check_rep=False,
+    )
+    def run(r, s, c):
+        fixed = table.msm(r)
+        sc = cv.scalar_mul(s, c[:, None, :])
+        return cv.add(fixed, cv.neg(sc))
+
+    return run(resp, stmts, chals)

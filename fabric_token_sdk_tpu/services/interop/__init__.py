@@ -1,0 +1,1 @@
+from .htlc import HTLCScript, lock, claim, reclaim  # noqa: F401

@@ -1,0 +1,1 @@
+from .nft import NFTService  # noqa: F401

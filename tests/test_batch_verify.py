@@ -1,0 +1,67 @@
+"""Batched device verification vs host verifiers (slow: pairing compiles)."""
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import batch, hostmath as hm, pssign, sigproof
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.crypto import token as tok, wellformedness as wf
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup(base=4, exponent=2)
+
+
+def test_batched_wf_verify(rng, pp):
+    txs = []
+    for i in range(3):
+        in_toks, in_w = tok.tokens_with_witness([5, 10], "USD", pp.ped_params, rng)
+        out_toks, out_w = tok.tokens_with_witness([7, 8], "USD", pp.ped_params, rng)
+        raw = wf.TransferWFProver(
+            wf.TransferWFWitness(
+                "USD",
+                [w.value for w in in_w], [w.bf for w in in_w],
+                [w.value for w in out_w], [w.bf for w in out_w],
+            ),
+            pp.ped_params, in_toks, out_toks, rng,
+        ).prove()
+        txs.append((in_toks, out_toks, raw))
+    # tamper the last one
+    bad = wf.TransferWF.from_bytes(txs[2][2])
+    bad.sum_resp = (bad.sum_resp + 1) % hm.R
+    txs[2] = (txs[2][0], txs[2][1], bad.to_bytes())
+    verifier = batch.BatchedWFVerifier(pp)
+    got = verifier.verify(txs)
+    assert got.tolist() == [True, True, False]
+
+
+@pytest.mark.slow
+def test_batched_ps_verify(rng):
+    signer = pssign.keygen(1, rng)
+    msgs = [[3], [1], [2]]
+    sigs = [signer.sign(m, rng) for m in msgs]
+    # corrupt one signature
+    sigs[1] = pssign.Signature(sigs[1].R, hm.g1_mul(sigs[1].S, 2))
+    v = batch.BatchedPSVerifier(signer.pk, signer.Q)
+    got = v.verify(msgs, sigs)
+    assert got.tolist() == [True, False, True]
+
+
+@pytest.mark.slow
+def test_batched_membership_verify(rng, pp):
+    rp = pp.range_params
+    proofs, coms = [], []
+    for value in (0, 3, 2):
+        bf = hm.rand_zr(rng)
+        com = hm.g1_multiexp(pp.ped_params[:2], [value, bf])
+        w = sigproof.MembershipWitness(rp.signed_values[value], value, bf)
+        proofs.append(
+            sigproof.MembershipProver(
+                w, com, pp.ped_gen, rp.Q, rp.sign_pk, pp.ped_params[:2], rng
+            ).prove()
+        )
+        coms.append(com)
+    proofs[2].value_resp = (proofs[2].value_resp + 1) % hm.R
+    v = batch.BatchedMembershipVerifier(pp)
+    got = v.verify(proofs, coms)
+    assert got.tolist() == [True, True, False]

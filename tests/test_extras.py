@@ -1,0 +1,153 @@
+"""Tests: HTLC interop, NFT service, tokengen CLI, quantity model."""
+import hashlib
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.api.wallet import AuditorWallet
+from fabric_token_sdk_tpu.crypto import sign
+from fabric_token_sdk_tpu.drivers import identity
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.models.quantity import Quantity
+from fabric_token_sdk_tpu.services.interop import htlc
+from fabric_token_sdk_tpu.services.network import Network
+from fabric_token_sdk_tpu.services.nfttx import NFTService
+from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+
+
+def test_quantity_model():
+    q = Quantity.from_uint64(255)
+    assert q.hex() == "0xff" and q.decimal() == "255"
+    assert Quantity.from_hex("0xff").value == 255
+    assert q.add(Quantity.from_uint64(1)).value == 256
+    with pytest.raises(ValueError):
+        q.sub(Quantity.from_uint64(256))
+    with pytest.raises(ValueError):
+        Quantity(1 << 64, 64)
+    with pytest.raises(ValueError):
+        Quantity.from_hex("ff")
+
+
+def test_htlc_claim_reclaim(rng):
+    sender = sign.keygen(rng)
+    recipient = sign.keygen(rng)
+    preimage = b"super-secret"
+    h = hashlib.sha256(preimage).digest()
+    deadline = time.time() + 3600
+    script = htlc.lock(
+        identity.pk_identity(sender.public), identity.pk_identity(recipient.public),
+        h, deadline,
+    )
+    ident = script.to_identity()
+    msg = b"spend-tx"
+    # claim with preimage before deadline
+    sig = htlc.claim(script, preimage, lambda m: recipient.sign(m, rng), msg)
+    identity.verify_signature(ident, msg, sig)
+    # wrong preimage rejected at claim time
+    with pytest.raises(ValueError):
+        htlc.claim(script, b"wrong", lambda m: recipient.sign(m, rng), msg)
+    # forged claim signature rejected at verification
+    forged = htlc.HTLCClaimSignature(b"wrong", recipient.sign(msg, rng)).to_bytes()
+    with pytest.raises(ValueError):
+        identity.verify_signature(ident, msg, forged)
+    # reclaim only after deadline
+    with pytest.raises(ValueError):
+        htlc.reclaim(script, lambda m: sender.sign(m, rng), msg)
+    sig2 = htlc.reclaim(script, lambda m: sender.sign(m, rng), msg, now=deadline + 1)
+    htlc.verify_htlc_spend(ident, msg, sig2, now=deadline + 1)
+    # before the deadline a bare sender sig does not verify (claim rules)
+    with pytest.raises(ValueError):
+        identity.verify_signature(ident, msg, sig2)
+
+
+def test_htlc_token_flow(rng):
+    """Lock fabtokens under an HTLC script and claim them."""
+    pp = FabTokenPublicParams()
+    vdrv = FabTokenDriver(pp)
+    aw = AuditorWallet("auditor", sign.keygen())
+    net = Network(RequestValidator(vdrv, aw.identity))
+    from fabric_token_sdk_tpu.services.auditor import AuditorService
+    auditor = AuditorService(FabTokenDriver(pp), aw)
+    issuer_p = Party("issuer", FabTokenDriver(pp), net, aw.identity)
+    alice_p = Party("alice", FabTokenDriver(pp), net, aw.identity)
+    bob_p = Party("bob", FabTokenDriver(pp), net, aw.identity)
+    iw = issuer_p.new_issuer_wallet("issuer"); pp.add_issuer(iw.identity)
+    alice = alice_p.new_owner_wallet("alice", False)
+    bob = bob_p.new_owner_wallet("bob", False)
+
+    tx = Transaction(issuer_p, "mint")
+    tx.issue("issuer", "BTC", [5], [alice.recipient_identity()], anonymous=False)
+    tx.collect_endorsements(auditor); tx.submit()
+
+    preimage = b"swap-secret"
+    script = htlc.lock(
+        alice.recipient_identity(), bob.recipient_identity(),
+        hashlib.sha256(preimage).digest(), time.time() + 3600,
+    )
+    tx2 = Transaction(alice_p, "lock")
+    tx2.transfer("alice", "BTC", [5], [script.to_identity()])
+    tx2.collect_endorsements(auditor); tx2.submit()
+    assert alice_p.balance("BTC") == 0
+
+    # bob claims: build transfer spending the script token with a claim sig
+    from fabric_token_sdk_tpu.models.token import ID
+    script_id = [i for i in [ID("lock", 0)] if net.exists(i)][0]
+    out = net.resolve_input(script_id)
+    tx3 = Transaction(bob_p, "claim")
+    bob_p.tms.add_transfer(
+        tx3.request, [script_id], [out], [out], "BTC", [5],
+        [bob.recipient_identity()],
+    )
+    payload = tx3.request.marshal_to_sign()
+    tx3.request.transfers[0].signatures = [
+        htlc.claim(script, preimage, lambda m: bob.key.sign(m), payload)
+    ]
+    auditor.audit(tx3.request)
+    tx3.submit()
+    assert bob_p.balance("BTC") == 5
+
+
+def test_nft_flow(rng):
+    pp = FabTokenPublicParams()
+    vdrv = FabTokenDriver(pp)
+    aw = AuditorWallet("auditor", sign.keygen())
+    net = Network(RequestValidator(vdrv, aw.identity))
+    from fabric_token_sdk_tpu.services.auditor import AuditorService
+    auditor = AuditorService(FabTokenDriver(pp), aw)
+    issuer_p = Party("issuer", FabTokenDriver(pp), net, aw.identity)
+    alice_p = Party("alice", FabTokenDriver(pp), net, aw.identity)
+    bob_p = Party("bob", FabTokenDriver(pp), net, aw.identity)
+    iw = issuer_p.new_issuer_wallet("issuer"); pp.add_issuer(iw.identity)
+    alice = alice_p.new_owner_wallet("alice", False)
+    bob = bob_p.new_owner_wallet("bob", False)
+
+    state = {"artist": "banksy", "work": "ttx #1"}
+    nft_issuer = NFTService(issuer_p)
+    token_type = nft_issuer.issue("issuer", state, alice.recipient_identity(), auditor)
+    alice_nft = NFTService(alice_p)
+    assert alice_nft.my_nfts() == [token_type]
+    assert alice_nft.state_matches(token_type, state)
+    assert not alice_nft.state_matches(token_type, {"artist": "unknown", "work": "x"})
+    alice_nft.transfer("alice", token_type, bob.recipient_identity(), auditor)
+    assert alice_nft.my_nfts() == []
+    assert NFTService(bob_p).my_nfts() == [token_type]
+
+
+def test_tokengen_cli(tmp_path):
+    import sys
+    sys.path.insert(0, "cmd")
+    import tokengen
+    out = str(tmp_path / "arts")
+    tokengen.main(["gen", "fabtoken", "--output", out, "--issuers", "2",
+                   "--auditor", "--seed", "7"])
+    from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenPublicParams as FPP
+    raw = open(f"{out}/fabtoken_pp.json", "rb").read()
+    pp = FPP.deserialize(raw)
+    assert len(pp.issuers) == 2 and pp.auditor
+    out2 = str(tmp_path / "arts2")
+    tokengen.main(["gen", "dlog", "--output", out2, "--base", "2",
+                   "--exponent", "1", "--seed", "7"])
+    from fabric_token_sdk_tpu.crypto.setup import PublicParams
+    pp2 = PublicParams.deserialize(open(f"{out2}/zkatdlog_pp.json", "rb").read())
+    pp2.validate()
