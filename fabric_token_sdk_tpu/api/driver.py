@@ -96,8 +96,11 @@ class Driver(abc.ABC):
     def validate_transfer(self, action_bytes: bytes,
                           resolve_input,  # Callable[[ID], bytes]
                           signed_payload: bytes,
-                          signatures: Sequence[bytes]) -> Tuple[List[ID], List[bytes]]:
-        """Validate a transfer action; returns (spent ids, outputs to write)."""
+                          signatures: Sequence[bytes],
+                          now: Optional[float] = None) -> Tuple[List[ID], List[bytes]]:
+        """Validate a transfer action; returns (spent ids, outputs to write).
+        `now` is the deterministic commit timestamp (script deadlines etc.
+        must not depend on validator wall clocks)."""
 
     # ------------------------------------------------------------ tokens
 

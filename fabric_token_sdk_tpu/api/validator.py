@@ -28,7 +28,9 @@ class RequestValidator:
         self.driver = driver
         self.auditor = auditor_identity
 
-    def validate(self, request: TokenRequest, resolve_input: Callable[[ID], bytes]) -> ValidationResult:
+    def validate(self, request: TokenRequest, resolve_input: Callable[[ID], bytes],
+                 now=None) -> ValidationResult:
+        """`now`: deterministic commit timestamp for time-locked scripts."""
         result = ValidationResult()
         payload = request.marshal_to_sign()
 
@@ -57,7 +59,7 @@ class RequestValidator:
 
         for rec in request.transfers:
             spent, outputs = self.driver.validate_transfer(
-                rec.action, resolve_input, payload, rec.signatures
+                rec.action, resolve_input, payload, rec.signatures, now=now
             )
             if spent != rec.input_ids:
                 raise ValidationError("transfer record ids do not match action")

@@ -51,16 +51,24 @@ class BatchedPSVerifier:
         l = len(self.pk_host) - 2
         scal = np.zeros((B, l + 1, 32), dtype=np.int32)
         negS, R = [], []
+        malformed = np.zeros(B, dtype=bool)
         for i, (msgs, sig) in enumerate(zip(messages_rows, sigs)):
-            if len(msgs) != l:
-                raise ValueError("PS batch: message count mismatch")
-            ms = list(msgs) + [pssign.hash_messages(msgs)]
-            scal[i] = np.asarray(cv.encode_scalars(ms))
-            negS.append(hm.g1_neg(sig.S))
-            R.append(sig.R)
+            try:
+                if len(msgs) != l:
+                    raise ValueError("PS batch: message count mismatch")
+                ms = list(msgs) + [pssign.hash_messages(msgs)]
+                scal[i] = cv.encode_scalars(ms)
+                negS.append(hm.g1_neg(sig.S))
+                R.append(sig.R)
+            except Exception:
+                malformed[i] = True
+                negS.append(hm.G1_GEN)  # placeholder; row forced False
+                R.append(hm.G1_GEN)
         P1 = jnp.asarray(pr.encode_g1(negS))
         P2 = jnp.asarray(pr.encode_g1(R))
-        return np.asarray(self._kernel(jnp.asarray(scal), P1, P2))
+        out = np.asarray(self._kernel(jnp.asarray(scal), P1, P2))
+        out[malformed] = False
+        return out
 
     @functools.partial(jax.jit, static_argnums=0)
     def _kernel(self, scal, negS, R):
@@ -103,14 +111,20 @@ class BatchedWFVerifier:
         n_in = len(txs[0][0])
         n_out = len(txs[0][1])
         n = n_in + n_out + 2  # + the two aggregate statements
-        proofs = [TransferWF.from_bytes(t[2]) for t in txs]
+        proofs: List[Optional[TransferWF]] = []
+        for t in txs:
+            try:
+                proofs.append(TransferWF.from_bytes(t[2]))
+            except Exception:
+                proofs.append(None)  # malformed: row verifies False
         stmts: List = []
         resp = np.zeros((B, n, 3, 32), dtype=np.int32)
         chals = np.zeros((B, 32), dtype=np.int32)
         ok_shape = np.ones(B, dtype=bool)
         for i, ((inputs, outputs, _), wf) in enumerate(zip(txs, proofs)):
             if (
-                len(wf.input_values) != n_in
+                wf is None
+                or len(wf.input_values) != n_in
                 or len(wf.input_bfs) != n_in
                 or len(wf.output_values) != n_out
                 or len(wf.output_bfs) != n_out
@@ -152,7 +166,7 @@ class BatchedWFVerifier:
         com_pts = cv.decode_points(coms)  # B*n host points
         out = np.zeros(B, dtype=bool)
         for i, ((inputs, outputs, _), wf) in enumerate(zip(txs, proofs)):
-            if not ok_shape[i]:
+            if not ok_shape[i] or wf is None:
                 continue
             row = com_pts[i * n : (i + 1) * n]
             in_coms = row[: n_in + 1]
@@ -192,7 +206,6 @@ class BatchedMembershipVerifier:
         self.ped2 = pp.ped_params[:2]
         self.pk_dev = jnp.asarray(cv2.encode_points(self.pk))
         self.Q_aff = jnp.asarray(pr.encode_g2([self.Q]))[0]
-        self.pk0_neg_aff = jnp.asarray(pr.encode_g2([hm.g2_neg(self.pk[0])]))[0]
         self.table2 = cv.FixedBaseTable(self.ped2)
         self.tableP = cv.FixedBaseTable([self.P])
 
@@ -295,8 +308,14 @@ class BatchedTransferVerifier:
         transfer.go:55-59)."""
         B = len(txs)
         n_in, n_out = len(txs[0][0]), len(txs[0][1])
-        proofs = [TransferProof.from_bytes(t[2]) for t in txs]
+        proofs = []
         ok = np.ones(B, dtype=bool)
+        for i, t in enumerate(txs):
+            try:
+                proofs.append(TransferProof.from_bytes(t[2]))
+            except Exception:
+                proofs.append(TransferProof(wf=b"", range_correctness=None))
+                ok[i] = False
         wf_ok = self.wf.verify(
             [(t[0], t[1], p.wf) for t, p in zip(txs, proofs)]
         )

@@ -130,7 +130,8 @@ class FabTokenDriver(Driver):
         return outputs, issuer
 
     @vguard
-    def validate_transfer(self, action_bytes, resolve_input, signed_payload, signatures):
+    def validate_transfer(self, action_bytes, resolve_input, signed_payload,
+                          signatures, now=None):
         d = loads(action_bytes)
         ids = [ID(t, i) for t, i in d["ids"]]
         if not ids:
@@ -155,7 +156,7 @@ class FabTokenDriver(Driver):
             raise ValidationError("one signature per input owner required")
         for t, sig in zip(inputs, signatures):
             try:
-                identity.verify_signature(t.owner.raw, signed_payload, sig)
+                identity.verify_signature(t.owner.raw, signed_payload, sig, now=now)
             except ValueError as e:
                 raise ValidationError(f"invalid owner signature: {e}") from e
         return ids, d["outputs"]

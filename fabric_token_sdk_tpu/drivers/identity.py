@@ -40,7 +40,7 @@ def identity_kind(raw: bytes) -> str:
 
 
 def verify_signature(identity: bytes, message: bytes, signature: bytes,
-                     nym_params=None) -> None:
+                     nym_params=None, now=None) -> None:
     """Dispatch signature verification on the identity kind."""
     d = parse(identity)
     kind = d["t"]
@@ -55,6 +55,6 @@ def verify_signature(identity: bytes, message: bytes, signature: bytes,
         # avoid a services <-> drivers cycle)
         from ..services.interop.htlc import verify_htlc_spend
 
-        verify_htlc_spend(identity, message, signature, nym_params)
+        verify_htlc_spend(identity, message, signature, nym_params, now=now)
     else:
         raise ValueError(f"cannot verify signature for identity kind [{kind}]")

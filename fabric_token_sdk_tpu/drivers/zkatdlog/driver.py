@@ -127,7 +127,8 @@ class ZKATDLogDriver(Driver):
         return d["outputs"], issuer
 
     @vguard
-    def validate_transfer(self, action_bytes, resolve_input, signed_payload, signatures):
+    def validate_transfer(self, action_bytes, resolve_input, signed_payload,
+                          signatures, now=None):
         d = loads(action_bytes)
         ids = [ID(t, i) for t, i in d["ids"]]
         if not ids:
@@ -148,7 +149,8 @@ class ZKATDLogDriver(Driver):
         for t, sig in zip(in_tokens, signatures):
             try:
                 identity.verify_signature(
-                    t.owner, signed_payload, sig, nym_params=self.pp.nym_params
+                    t.owner, signed_payload, sig, nym_params=self.pp.nym_params,
+                    now=now,
                 )
             except ValueError as e:
                 raise ValidationError(f"invalid owner signature: {e}") from e

@@ -191,9 +191,13 @@ def decode_point(arr):
     return decode_points(arr[None])[0]
 
 
-def encode_scalars(ks) -> jnp.ndarray:
-    """Host ints -> canonical limb scalars (N, NLIMBS)."""
-    return jnp.asarray(lb.ints_to_limbs([k % hm.R for k in ks]))
+def encode_scalars(ks) -> np.ndarray:
+    """Host ints -> canonical limb scalars (N, NLIMBS).
+
+    Returns numpy (host data): batch-assembly loops stack many of these
+    before one device transfer; jit'd consumers convert implicitly.
+    """
+    return lb.ints_to_limbs([k % hm.R for k in ks])
 
 
 # ---------------------------------------------------------------- fixed base

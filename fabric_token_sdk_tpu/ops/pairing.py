@@ -35,7 +35,8 @@ from ..crypto import hostmath as hm
 # ---------------------------------------------------------------- constants
 
 _ATE_BITS = np.array([int(b) for b in bin(hm.ATE_LOOP)[3:]], dtype=np.int32)
-_U_BITS = np.array([int(b) for b in bin(hm.U)[3:]], dtype=np.int32)
+# ALL bits of u MSB-first ([2:] strips only '0b'); _pow_u skips the MSB itself
+_U_BITS = np.array([int(b) for b in bin(hm.U)[2:]], dtype=np.int32)
 
 # hard-part u-basis coefficients (c0..c3) per lambda_i — verified at import
 _LAMBDA_COEFFS = [

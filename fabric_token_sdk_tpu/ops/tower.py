@@ -277,10 +277,14 @@ def fp12_ones(shape=()):
     ).astype(jnp.int32)
 
 
+@jax.jit
 def fp12_eq(x, y):
-    return jnp.all(x == y, axis=(-1, -2, -3))
+    """Equality in the redundant [0, 2p) coefficient domain: canonicalize
+    every coefficient before comparing (v and v+p must test equal)."""
+    return jnp.all(FP.cond_sub_p(x) == FP.cond_sub_p(y), axis=(-1, -2, -3))
 
 
+@jax.jit
 def fp12_is_one(x):
     return fp12_eq(x, jnp.broadcast_to(fp12_ones(), x.shape).astype(jnp.int32))
 

@@ -36,10 +36,11 @@ def make_mesh(n_devices: Optional[int] = None, mp: int = 1) -> Mesh:
 
 
 def shard_rows(arr, mesh: Mesh):
-    """Place an array with its leading (batch) axis split over dp."""
-    spec = P("dp") if mesh.shape["mp"] == 1 else P("dp", "mp")
+    """Place an array with its leading (batch) axis split over dp; any
+    further sharding (e.g. mp over pairing legs) is imposed by the
+    consuming shard_map's in_specs."""
     ndim = np.asarray(arr).ndim
-    full = P(*(spec[: min(len(spec), 1)] + (None,) * (ndim - 1)))
+    full = P("dp", *([None] * (ndim - 1)))
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, full))
 
 

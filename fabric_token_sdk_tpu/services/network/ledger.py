@@ -10,6 +10,7 @@ current state, detects conflicts (already-spent inputs — the distributed
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
@@ -38,6 +39,7 @@ class FinalityEvent:
 class Block:
     number: int
     txs: List[str] = field(default_factory=list)
+    timestamp: float = 0.0
 
 
 class Network:
@@ -93,8 +95,11 @@ class Network:
             with self._lock:
                 if tx_id in self._status:
                     return self._status[tx_id]  # idempotent resubmission
+                commit_time = time.time()
                 try:
-                    result = self.validator.validate(request, self._resolve_locked)
+                    result = self.validator.validate(
+                        request, self._resolve_locked, now=commit_time
+                    )
                     # MVCC conflict check happens inside _resolve_locked;
                     # apply atomically
                     for token_id in result.spent:
@@ -109,7 +114,7 @@ class Network:
                 except ValidationError as e:
                     event = FinalityEvent(tx_id, TxStatus.INVALID, str(e))
                 self._status[tx_id] = event
-                self._blocks.append(Block(len(self._blocks), [tx_id]))
+                self._blocks.append(Block(len(self._blocks), [tx_id], commit_time))
             for listener in self._listeners:
                 listener(event, request)
             return event
@@ -121,3 +126,37 @@ class Network:
         if key not in self._state:
             raise ValidationError(f"token {token_id} does not exist")
         return self._state[key]
+
+    # --------------------------------------------------- checkpoint/resume
+
+    def snapshot(self) -> bytes:
+        """Serialize ledger state (checkpoint; reference parity: vault +
+        ledger recovery on node restart)."""
+        from ...crypto.serialization import dumps
+
+        with self._lock:
+            return dumps(
+                {
+                    "state": dict(self._state),
+                    "spent": sorted(self._spent),
+                    "blocks": [[b.number, b.txs, b.timestamp] for b in self._blocks],
+                    "status": {
+                        t: [e.status.value, e.message]
+                        for t, e in self._status.items()
+                    },
+                }
+            )
+
+    @classmethod
+    def restore(cls, validator: RequestValidator, raw: bytes) -> "Network":
+        from ...crypto.serialization import loads
+
+        d = loads(raw)
+        net = cls(validator)
+        net._state = dict(d["state"])
+        net._spent = set(d["spent"])
+        net._blocks = [Block(*row) for row in d["blocks"]]
+        net._status = {
+            t: FinalityEvent(t, TxStatus(s), m) for t, (s, m) in d["status"].items()
+        }
+        return net

@@ -1,0 +1,163 @@
+"""Multi-process network: a TCP ordering/ledger node + thin client.
+
+Reference parity: the SDK talks to a Fabric network over gRPC
+(`token/services/network/fabric`); here a JSON-over-TCP node hosts the
+MVCC ledger + validator, and `RemoteNetwork` exposes the same API surface
+as the in-process `Network` so parties can live in separate processes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ...api.driver import ValidationError
+from ...api.request import TokenRequest
+from ...api.validator import RequestValidator
+from ...models.token import ID
+from .ledger import FinalityEvent, Network, TxStatus
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj).encode()
+    sock.sendall(len(raw).to_bytes(4, "big") + raw)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    n = int.from_bytes(hdr, "big")
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return json.loads(buf.decode())
+
+
+class LedgerServer:
+    """Hosts a Network (orderer + endorser + committer) over TCP."""
+
+    def __init__(self, validator: RequestValidator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.network = Network(validator)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = _recv_msg(self.request)
+                    if msg is None:
+                        return
+                    _send_msg(self.request, outer._dispatch(msg))
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.address: Tuple[str, int] = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "LedgerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, msg: dict) -> dict:
+        try:
+            op = msg["op"]
+            if op == "submit":
+                ev = self.network.submit(bytes.fromhex(msg["request"]))
+                return {"ok": True, "status": ev.status.value, "message": ev.message,
+                        "tx_id": ev.tx_id}
+            if op == "resolve":
+                raw = self.network.resolve_input(ID(msg["tx_id"], msg["index"]))
+                return {"ok": True, "output": raw.hex()}
+            if op == "exists":
+                return {"ok": True, "exists": self.network.exists(ID(msg["tx_id"], msg["index"]))}
+            if op == "status":
+                ev = self.network.status(msg["tx_id"])
+                if ev is None:
+                    return {"ok": True, "status": None}
+                return {"ok": True, "status": ev.status.value, "message": ev.message}
+            if op == "height":
+                return {"ok": True, "height": self.network.height()}
+            return {"ok": False, "error": f"unknown op [{op}]"}
+        except ValidationError as e:
+            return {"ok": False, "validation_error": str(e)}
+        except Exception:  # defensive: never kill the server loop
+            return {"ok": False, "error": "malformed request"}
+
+
+class RemoteNetwork:
+    """Client-side Network facade over a LedgerServer.
+
+    Note: finality events are delivered on submit responses (poll-based),
+    so each party process drives its own vault via `apply_finality`.
+    """
+
+    def __init__(self, address: Tuple[str, int]):
+        self.address = tuple(address)
+        self._listeners: List[Callable] = []
+        self._lock = threading.Lock()
+
+    def _call(self, msg: dict) -> dict:
+        with socket.create_connection(self.address, timeout=30) as sock:
+            _send_msg(sock, msg)
+            resp = _recv_msg(sock)
+        if resp is None:
+            raise ConnectionError("ledger server closed the connection")
+        if not resp.get("ok"):
+            if "validation_error" in resp:
+                raise ValidationError(resp["validation_error"])
+            raise RuntimeError(resp.get("error", "remote error"))
+        return resp
+
+    def subscribe(self, listener) -> None:
+        self._listeners.append(listener)
+
+    def submit(self, request_bytes: bytes) -> FinalityEvent:
+        resp = self._call({"op": "submit", "request": request_bytes.hex()})
+        event = FinalityEvent(resp["tx_id"], TxStatus(resp["status"]), resp["message"])
+        request = TokenRequest.from_bytes(request_bytes)
+        for listener in self._listeners:
+            listener(event, request)
+        return event
+
+    def resolve_input(self, token_id: ID) -> bytes:
+        resp = self._call({"op": "resolve", "tx_id": token_id.tx_id, "index": token_id.index})
+        return bytes.fromhex(resp["output"])
+
+    def exists(self, token_id: ID) -> bool:
+        return self._call(
+            {"op": "exists", "tx_id": token_id.tx_id, "index": token_id.index}
+        )["exists"]
+
+    def status(self, tx_id: str) -> Optional[FinalityEvent]:
+        resp = self._call({"op": "status", "tx_id": tx_id})
+        if resp["status"] is None:
+            return None
+        return FinalityEvent(tx_id, TxStatus(resp["status"]), resp.get("message", ""))
+
+    def height(self) -> int:
+        return self._call({"op": "height"})["height"]
+
+    def apply_finality(self, request_bytes: bytes) -> Optional[FinalityEvent]:
+        """Receiver-side sync: given a request distributed off-band (the
+        reference's recipient/ttx views), look up its final status on the
+        ledger and replay it into local listeners (vault, ttxdb)."""
+        request = TokenRequest.from_bytes(request_bytes)
+        event = self.status(request.anchor)
+        if event is not None:
+            for listener in self._listeners:
+                listener(event, request)
+        return event

@@ -1,0 +1,53 @@
+"""Sharded kernels on the 8-virtual-device CPU mesh == unsharded results."""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+from fabric_token_sdk_tpu.ops import curve as cv
+from fabric_token_sdk_tpu.parallel import make_mesh, shard_rows, sharded_wf_verify_kernel
+
+
+def test_mesh_shapes():
+    assert len(jax.devices()) == 8
+    mesh = make_mesh(8, mp=2)
+    assert mesh.shape == {"dp": 4, "mp": 2}
+    with pytest.raises(ValueError):
+        make_mesh(8, mp=3)
+
+
+def test_sharded_schnorr_kernel_matches_host(rng):
+    bases = [hm.rand_g1(rng) for _ in range(3)]
+    table = cv.FixedBaseTable(bases)
+    mesh = make_mesh(8, mp=1)
+    B, n = 8, 2
+    resp = np.zeros((B, n, 3, 32), dtype=np.int32)
+    stmt = np.zeros((B, n, 3, 32), dtype=np.int32)
+    chal = np.zeros((B, 32), dtype=np.int32)
+    expected = []
+    for b in range(B):
+        c = rng.randrange(hm.R)
+        chal[b] = np.asarray(cv.encode_scalars([c]))[0]
+        for j in range(n):
+            zs = [rng.randrange(hm.R) for _ in range(3)]
+            st = hm.rand_g1(rng)
+            stmt[b, j] = cv.encode_point(st)
+            resp[b, j] = np.asarray(cv.encode_scalars(zs))
+            expected.append(
+                hm.g1_add(hm.g1_multiexp(bases, zs), hm.g1_neg(hm.g1_mul(st, c)))
+            )
+    out = sharded_wf_verify_kernel(
+        table, shard_rows(resp, mesh), shard_rows(stmt, mesh),
+        shard_rows(chal, mesh), mesh,
+    )
+    assert cv.decode_points(out) == expected
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
