@@ -22,6 +22,11 @@ import sys
 import threading
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fabric_token_sdk_tpu import jaxcache
+
+jaxcache.enable()
+
 
 def _platform_guard() -> str:
     """Probe device init in a watchdog thread; fall back to CPU if the

@@ -64,17 +64,24 @@ class BatchedPSVerifier:
                 malformed[i] = True
                 negS.append(hm.G1_GEN)  # placeholder; row forced False
                 R.append(hm.G1_GEN)
-        P1 = jnp.asarray(pr.encode_g1(negS))
-        P2 = jnp.asarray(pr.encode_g1(R))
-        out = np.asarray(self._kernel(jnp.asarray(scal), P1, P2))
+        P1 = np.asarray(pr.encode_g1(negS))
+        P2 = np.asarray(pr.encode_g1(R))
+        H_aff = np.asarray(self._kernel_g2(jnp.asarray(scal)))
+        Ps = np.stack([P1, P2], axis=1)  # (B, 2, 2, L) G1 affine
+        Qs = np.stack(
+            [np.broadcast_to(np.asarray(self.Q_aff), H_aff.shape), H_aff],
+            axis=1,
+        )  # (B, 2, 2, 2, L)
+        gt = pr.pairing_product_staged(Ps, Qs)
+        # np.array (copy): device arrays surface as read-only numpy views
+        out = np.array(pr.gt_is_one(gt))
         out[malformed] = False
         return out
 
     @functools.partial(jax.jit, static_argnums=0)
-    def _kernel(self, scal, negS, R):
+    def _kernel_g2(self, scal):
+        """H = PK0 + sum PK_i^{m_i} (+ PK_last^{hash}) in G2 -> affine."""
         B = scal.shape[0]
-        l1 = scal.shape[1]
-        # H = PK0 + sum PK_i^{m_i} (+ PK_last^{hash}) in G2
         bases = jnp.broadcast_to(
             self.pk_dev[1:], (B,) + self.pk_dev[1:].shape
         )  # (B, l+1, 3, 2, L)
@@ -82,13 +89,7 @@ class BatchedPSVerifier:
         acc = cv2.tree_sum(terms, axis=-4)  # (B, 3, 2, L)
         pk0 = jnp.broadcast_to(self.pk_dev[0], acc.shape)
         H = cv2.add(acc, pk0)
-        H_aff = cv2.to_affine_device(H)  # (B, 2, 2, L)
-        Ps = jnp.stack([negS, R], axis=1)  # (B, 2, 2, L) G1 affine
-        Qs = jnp.stack(
-            [jnp.broadcast_to(self.Q_aff, H_aff.shape), H_aff], axis=1
-        )  # (B, 2, 2, 2, L)
-        gt = pr.pairing_product(Ps, Qs)
-        return pr.gt_is_one(gt)
+        return cv2.to_affine_device(H)  # (B, 2, 2, L)
 
 
 # ===================================================================
@@ -206,6 +207,8 @@ class BatchedMembershipVerifier:
         self.ped2 = pp.ped_params[:2]
         self.pk_dev = jnp.asarray(cv2.encode_points(self.pk))
         self.Q_aff = jnp.asarray(pr.encode_g2([self.Q]))[0]
+        self.Q_np = np.asarray(pr.encode_g2([self.Q]))[0]
+        self.pk0_np = np.asarray(pr.encode_g2([self.pk[0]]))[0]
         self.table2 = cv.FixedBaseTable(self.ped2)
         self.tableP = cv.FixedBaseTable([self.P])
 
@@ -226,13 +229,30 @@ class BatchedMembershipVerifier:
             S_pts.append(p.signature.S)
             R_pts.append(p.signature.R)
             com_pts.append(com)
-        gt, com_val = self._kernel(
+        t_aff, negSc, Rc, Pz, R_aff, com_val = self._kernel_pre(
             jnp.asarray(z),
             jnp.asarray(com_resp),
             jnp.asarray(pr.encode_g1(S_pts)),
             jnp.asarray(pr.encode_g1(R_pts)),
             jnp.asarray(np.stack([cv.encode_point(c) for c in com_pts])),
         )
+        # 4-leg pairing product via the compile-once staged tile programs
+        t_aff = np.asarray(t_aff)
+        Ps = np.stack(
+            [np.asarray(negSc), np.asarray(Rc), np.asarray(R_aff),
+             np.asarray(Pz)],
+            axis=1,
+        )  # (B, 4, 2, L)
+        Q_np = self.Q_np
+        pk0_np = self.pk0_np
+        Qs = np.stack(
+            [np.broadcast_to(Q_np, t_aff.shape),
+             np.broadcast_to(pk0_np, t_aff.shape),
+             t_aff,
+             np.broadcast_to(Q_np, t_aff.shape)],
+            axis=1,
+        )  # (B, 4, 2, 2, L)
+        gt = pr.pairing_product_staged(Ps, Qs)
         gt_host = tw.decode_fp12(gt)
         com_host = cv.decode_points(com_val)
         out = np.zeros(B, dtype=bool)
@@ -245,7 +265,8 @@ class BatchedMembershipVerifier:
         return out
 
     @functools.partial(jax.jit, static_argnums=0)
-    def _kernel(self, z, com_resp, S, R, com_jac):
+    def _kernel_pre(self, z, com_resp, S, R, com_jac):
+        """Group-side reconstruction; pairing runs via the staged tiles."""
         B = z.shape[0]
         # G2 term: t = PK1^{z_v} + PK2^{z_h}
         bases = jnp.broadcast_to(self.pk_dev[1:3], (B, 2) + self.pk_dev.shape[1:])
@@ -262,25 +283,11 @@ class BatchedMembershipVerifier:
         Rc_aff = _jac_to_affine(powc[:, 1])
         Pz = _jac_to_affine(self.tableP.msm(z[:, 2:3]))  # P^{z_bf}
         R_aff = _jac_to_affine(Rj)
-        # pairing product over 4 pairs
-        Ps = jnp.stack([negSc_aff, Rc_aff, R_aff, Pz], axis=1)
-        Qs = jnp.stack(
-            [
-                jnp.broadcast_to(self.Q_aff, t_aff.shape),
-                jnp.broadcast_to(
-                    jnp.asarray(pr.encode_g2([self.pk[0]]))[0], t_aff.shape
-                ),
-                t_aff,
-                jnp.broadcast_to(self.Q_aff, t_aff.shape),
-            ],
-            axis=1,
-        )
-        gt = pr.pairing_product(Ps, Qs)
         # G1 commitment: ped0^{z_v} ped1^{z_cb} - com^c
         fixed = self.table2.msm(com_resp)
         comc = cv.scalar_mul(com_jac, z[:, 3])
         com_val = cv.add(fixed, cv.neg(comc))
-        return gt, com_val
+        return t_aff, negSc_aff, Rc_aff, Pz, R_aff, com_val
 
 
 # ===================================================================

@@ -615,6 +615,90 @@ def gt_to_bytes(e) -> bytes:
     return b"".join(c[0].to_bytes(32, "big") + c[1].to_bytes(32, "big") for c in e)
 
 
+# ------------------------------------------------- native G1 fast path
+#
+# The reference's host math is gnark-crypto assembly behind IBM mathlib;
+# ours is ../native/bn254.c (Montgomery 4x64 Jacobian G1) behind ctypes.
+# The pure-Python definitions above remain the correctness anchor (and the
+# fallback when no C compiler is present): differential tests compare the
+# two (tests/test_native_bn254.py). Opt out with FTS_TPU_NO_NATIVE=1.
+
+g1_mul_py = g1_mul
+g1_multiexp_py = g1_multiexp
+g1_sum_py = g1_sum
+g2_mul_py = g2_mul
+g2_multiexp_py = g2_multiexp
+g2_sum_py = g2_sum
+pairing_py = pairing
+pairing_product_py = pairing_product
+NATIVE_G1 = False
+
+
+def _install_native() -> None:
+    global g1_mul, g1_multiexp, g1_sum, NATIVE_G1
+    global g2_mul, g2_multiexp, g2_sum, pairing, pairing_product
+    import os
+
+    if os.environ.get("FTS_TPU_NO_NATIVE"):
+        return
+    try:
+        from ..native import bn254py as _nb
+
+        if not _nb.available():
+            return
+        # round-trip self-checks before trusting the build
+        if _nb.g1_mul(G1_GEN, 12345) != g1_mul_py(G1_GEN, 12345):
+            return  # pragma: no cover
+        if _nb.pairing(G1_GEN, G2_GEN) != pairing_py(G1_GEN, G2_GEN):
+            return  # pragma: no cover
+    except Exception:  # pragma: no cover
+        return
+
+    def _g1_sum(points):
+        return _nb.g1_sum(list(points))
+
+    def _g2_sum(points):
+        return _nb.g2_sum(list(points))
+
+    def _pairing(p, q):
+        if p is None or q is None:
+            return FP12_ONE  # final_exp(miller_loop) of an infinite pair
+        return _nb.pairing(p, q)
+
+    def _pairing_product(pairs):
+        return _nb.pairing_product(list(pairs))
+
+    # mul/multiexp bind straight to the ctypes layer (it validates lengths
+    # and reduces scalars mod R itself); sum/product wrappers only coerce
+    # generators / handle infinity.
+    g1_mul = _nb.g1_mul
+    g1_multiexp = _nb.g1_multiexp
+    g1_sum = _g1_sum
+    g2_mul = _nb.g2_mul
+    g2_multiexp = _nb.g2_multiexp
+    g2_sum = _g2_sum
+    pairing = _pairing
+    pairing_product = _pairing_product
+    NATIVE_G1 = True
+
+
+_install_native()
+
+
+def g1_mul_batch(points, scalars):
+    """[k_i P_i] in one native call (falls back to a Python loop)."""
+    points, scalars = list(points), list(scalars)
+    if len(points) != len(scalars):
+        raise ValueError(
+            f"mul_batch length mismatch: {len(points)} != {len(scalars)}"
+        )
+    if NATIVE_G1:
+        from ..native import bn254py as _nb
+
+        return _nb.g1_mul_batch(points, scalars)
+    return [g1_mul_py(p, k) for p, k in zip(points, scalars)]
+
+
 # ---------------------------------------------------------------- hashing
 
 def hash_to_zr(data: bytes, domain: bytes = b"fts-tpu/zr") -> int:

@@ -1,0 +1,22 @@
+"""Shared persistent-XLA-compilation-cache bootstrap.
+
+The limb-tensor programs are compile-heavy (minutes each on a small CPU
+host); every entry point (test suite, bench, graft entry) funnels through
+`enable()` BEFORE importing jax so they all share one content-addressed
+cache directory. Safe across concurrent processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def enable(root: str | None = None) -> str:
+    """Point JAX at the shared on-disk compilation cache (idempotent)."""
+    cache = os.path.join(root or _REPO_ROOT, ".jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]

@@ -31,6 +31,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the batched pairing/curve programs are
+# compile-heavy; caching cuts repeat suite runs from tens of minutes to
+# minutes. Safe to share across processes (content-addressed).
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from fabric_token_sdk_tpu import jaxcache
+
+jaxcache.enable()
+
 import random
 
 import pytest

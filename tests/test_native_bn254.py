@@ -1,0 +1,123 @@
+"""Differential tests: native C BN254 host library vs the pure-Python twin.
+
+Mirrors the reference's reliance on differential trust in its math backend
+(mathlib pinned against gnark-crypto); here bn254.c must agree with
+`crypto.hostmath`'s big-int definitions on every exported operation.
+"""
+
+import random
+
+import pytest
+
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+from fabric_token_sdk_tpu.native import bn254py as nb
+
+pytestmark = pytest.mark.skipif(
+    not nb.available(), reason="no C compiler / native build unavailable"
+)
+
+rng = random.Random(0xBEEF)
+
+
+def _rand_pts(n):
+    pts = [hm.g1_mul_py(hm.G1_GEN, rng.randrange(1, hm.R)) for _ in range(n)]
+    pts[n // 2] = None  # include infinity
+    return pts
+
+
+def test_mul_batch_matches_python():
+    pts = _rand_pts(8)
+    ks = [rng.randrange(hm.R) for _ in range(8)]
+    assert nb.g1_mul_batch(pts, ks) == [
+        hm.g1_mul_py(p, k) for p, k in zip(pts, ks)
+    ]
+
+
+def test_mul_edge_scalars():
+    g = hm.G1_GEN
+    assert nb.g1_mul(g, 0) is None
+    assert nb.g1_mul(g, hm.R) is None
+    assert nb.g1_mul(g, 1) == g
+    assert nb.g1_mul(g, hm.R - 1) == hm.g1_neg(g)
+    assert nb.g1_mul(None, 123) is None
+    # scalars are reduced mod R on the way in
+    k = rng.randrange(hm.R)
+    assert nb.g1_mul(g, k + hm.R) == hm.g1_mul_py(g, k)
+
+
+def test_multiexp_and_sum_match_python():
+    pts = _rand_pts(6)
+    ks = [rng.randrange(hm.R) for _ in range(6)]
+    assert nb.g1_multiexp(pts, ks) == hm.g1_multiexp_py(pts, ks)
+    assert nb.g1_sum(pts) == hm.g1_sum_py(pts)
+    assert nb.g1_multiexp([], []) is None
+
+
+def test_multiexp_rows():
+    rows_p = [_rand_pts(3) for _ in range(4)]
+    rows_k = [[rng.randrange(hm.R) for _ in range(3)] for _ in range(4)]
+    assert nb.g1_multiexp_rows(rows_p, rows_k) == [
+        hm.g1_multiexp_py(p, k) for p, k in zip(rows_p, rows_k)
+    ]
+
+
+def test_hostmath_fast_path_installed():
+    # In-process hostmath should have adopted the native path (unless the
+    # env opted out), and its results must equal the pure twin's.
+    k = rng.randrange(hm.R)
+    assert hm.g1_mul(hm.G1_GEN, k) == hm.g1_mul_py(hm.G1_GEN, k)
+    pts = _rand_pts(4)
+    ks = [rng.randrange(hm.R) for _ in range(4)]
+    assert hm.g1_multiexp(pts, ks) == hm.g1_multiexp_py(pts, ks)
+    assert hm.g1_sum(pts) == hm.g1_sum_py(pts)
+    assert hm.g1_mul_batch(pts, ks) == [hm.g1_mul_py(p, k) for p, k in zip(pts, ks)]
+
+
+def test_g2_ops_match_python():
+    ks = [rng.randrange(hm.R) for _ in range(3)]
+    pts = [hm.g2_mul_py(hm.G2_GEN, k + 1) for k in ks] + [None]
+    ks.append(7)
+    assert nb.g2_mul_batch(pts, ks) == [
+        hm.g2_mul_py(p, k) for p, k in zip(pts, ks)
+    ]
+    assert nb.g2_mul(hm.G2_GEN, 0) is None
+    assert nb.g2_mul(hm.G2_GEN, 1) == hm.G2_GEN
+    assert nb.g2_mul(hm.G2_GEN, hm.R - 1) == hm.g2_neg(hm.G2_GEN)
+    assert nb.g2_multiexp(pts, ks) == hm.g2_multiexp_py(pts, ks)
+    assert nb.g2_sum(pts) == hm.g2_sum_py(pts)
+
+
+def test_pairing_matches_python():
+    p = hm.g1_mul_py(hm.G1_GEN, 3)
+    q = hm.g2_mul_py(hm.G2_GEN, 5)
+    assert nb.pairing(p, q) == hm.pairing_py(p, q)
+
+
+def test_pairing_bilinearity_and_product():
+    p = hm.g1_mul_py(hm.G1_GEN, 11)
+    q = hm.g2_mul_py(hm.G2_GEN, 13)
+    a = rng.randrange(1, 1 << 30)
+    assert nb.pairing(hm.g1_mul_py(p, a), q) == nb.pairing(p, hm.g2_mul_py(q, a))
+    # e(P,Q) e(-P,Q) = 1 under the shared final exponentiation
+    assert nb.pairing_product([(p, q), (hm.g1_neg(p), q)]) == hm.FP12_ONE
+    # infinite legs contribute identity
+    assert nb.pairing_product([(None, q), (p, None)]) == hm.FP12_ONE
+    assert hm.gt_is_unity(nb.pairing_product([]))
+
+
+def test_hostmath_pairing_fast_path():
+    p = hm.g1_mul_py(hm.G1_GEN, 4)
+    q = hm.g2_mul_py(hm.G2_GEN, 9)
+    assert hm.pairing(p, q) == hm.pairing_py(p, q)
+    assert hm.pairing(None, q) == hm.FP12_ONE
+    assert hm.pairing_product([(p, q)]) == hm.pairing_product_py([(p, q)])
+    k = rng.randrange(hm.R)
+    assert hm.g2_mul(hm.G2_GEN, k) == hm.g2_mul_py(hm.G2_GEN, k)
+
+
+def test_cancellation_inside_sum():
+    # exercises the add -> inverse/doubling branches in C
+    p = hm.g1_mul_py(hm.G1_GEN, 7)
+    assert nb.g1_sum([p, hm.g1_neg(p)]) is None
+    assert nb.g1_sum([p, p]) == hm.g1_mul_py(hm.G1_GEN, 14)
+    assert nb.g1_multiexp([p, p], [5, hm.R - 5]) is None
