@@ -31,6 +31,41 @@ from ..ops import curve as cv, curve2 as cv2, pairing as pr, tower as tw
 from ..ops.field import FP
 
 
+# -------------------------------------------------------------- tiling
+#
+# Device kernels run in fixed ROW_TILE slabs (padding by repeating row 0;
+# padded outputs are discarded), so each kernel compiles exactly once per
+# *trailing* shape no matter the batch size — bench and tests share the
+# same cached programs.
+
+ROW_TILE = 8
+
+
+def _run_tiled(kernel, *arrays, consts=()):
+    """kernel(*consts, *(tile slices)) over ROW_TILE slabs -> numpy.
+
+    `consts` are parameter tensors (tables, public keys) passed whole to
+    every tile call — as ARGUMENTS, not baked jit constants, so compiled
+    programs are shared across parameter sets.
+    """
+    B = arrays[0].shape[0]
+    pad = (-B) % ROW_TILE
+    if pad:
+        arrays = tuple(
+            np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in arrays
+        )
+    outs = [
+        kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
+        for t in range(0, B + pad, ROW_TILE)
+    ]
+    if isinstance(outs[0], (tuple, list)):
+        return tuple(
+            np.concatenate([np.asarray(o[i]) for o in outs])[:B]
+            for i in range(len(outs[0]))
+        )
+    return np.concatenate([np.asarray(o) for o in outs])[:B]
+
+
 # ===================================================================
 # Pointcheval-Sanders batch verification
 # ===================================================================
@@ -48,6 +83,8 @@ class BatchedPSVerifier:
     def verify(self, messages_rows: Sequence[Sequence[int]], sigs) -> np.ndarray:
         """-> bool array (B,). Raises nothing; invalid rows are False."""
         B = len(sigs)
+        if B == 0:
+            return np.zeros(0, dtype=bool)
         l = len(self.pk_host) - 2
         scal = np.zeros((B, l + 1, 32), dtype=np.int32)
         negS, R = [], []
@@ -66,7 +103,7 @@ class BatchedPSVerifier:
                 R.append(hm.G1_GEN)
         P1 = np.asarray(pr.encode_g1(negS))
         P2 = np.asarray(pr.encode_g1(R))
-        H_aff = np.asarray(self._kernel_g2(jnp.asarray(scal)))
+        H_aff = _run_tiled(_ps_g2_kernel, scal, consts=(self.pk_dev,))
         Ps = np.stack([P1, P2], axis=1)  # (B, 2, 2, L) G1 affine
         Qs = np.stack(
             [np.broadcast_to(np.asarray(self.Q_aff), H_aff.shape), H_aff],
@@ -78,18 +115,20 @@ class BatchedPSVerifier:
         out[malformed] = False
         return out
 
-    @functools.partial(jax.jit, static_argnums=0)
-    def _kernel_g2(self, scal):
-        """H = PK0 + sum PK_i^{m_i} (+ PK_last^{hash}) in G2 -> affine."""
-        B = scal.shape[0]
-        bases = jnp.broadcast_to(
-            self.pk_dev[1:], (B,) + self.pk_dev[1:].shape
-        )  # (B, l+1, 3, 2, L)
-        terms = cv2.scalar_mul(bases, scal)  # batched over (B, l+1)
-        acc = cv2.tree_sum(terms, axis=-4)  # (B, 3, 2, L)
-        pk0 = jnp.broadcast_to(self.pk_dev[0], acc.shape)
-        H = cv2.add(acc, pk0)
-        return cv2.to_affine_device(H)  # (B, 2, 2, L)
+
+@jax.jit
+def _ps_g2_kernel(pk_dev, scal):
+    """H = PK0 + sum PK_i^{m_i} (+ PK_last^{hash}) in G2 -> affine.
+
+    pk_dev is an argument, not a constant: one compiled program serves
+    every PS public key of the same message length."""
+    B = scal.shape[0]
+    bases = jnp.broadcast_to(pk_dev[1:], (B,) + pk_dev[1:].shape)
+    terms = cv2.scalar_mul(bases, scal)  # batched over (B, l+1)
+    acc = cv2.tree_sum(terms, axis=-4)  # (B, 3, 2, L)
+    pk0 = jnp.broadcast_to(pk_dev[0], acc.shape)
+    H = cv2.add(acc, pk0)
+    return cv2.to_affine_device(H)  # (B, 2, 2, L)
 
 
 # ===================================================================
@@ -160,10 +199,12 @@ class BatchedWFVerifier:
                 resp[i, j] = np.asarray(cv.encode_scalars(r))
             chals[i] = np.asarray(cv.encode_scalars([wf.challenge]))[0]
 
-        stmt_dev = jnp.asarray(
-            np.stack([cv.encode_point(s) for s in stmts]).reshape(B, n, 3, 32)
+        stmt_np = np.stack([cv.encode_point(s) for s in stmts]).reshape(
+            B, n, 3, 32
         )
-        coms = self._kernel(jnp.asarray(resp), stmt_dev, jnp.asarray(chals))
+        coms = _run_tiled(
+            _wf_kernel, resp, stmt_np, chals, consts=(self.table.flat,)
+        )
         com_pts = cv.decode_points(coms)  # B*n host points
         out = np.zeros(B, dtype=bool)
         for i, ((inputs, outputs, _), wf) in enumerate(zip(txs, proofs)):
@@ -178,12 +219,16 @@ class BatchedWFVerifier:
             out[i] = chal == wf.challenge
         return out
 
-    @functools.partial(jax.jit, static_argnums=0)
-    def _kernel(self, resp, stmts, chals):
-        """com_j = prod ped_i^{resp_ji} - stmt_j^challenge, batched."""
-        fixed = self.table.msm(resp)  # (B, n, 3, L)
-        sc = cv.scalar_mul(stmts, chals[:, None, :])  # (B, n, 3, L)
-        return cv.add(fixed, cv.neg(sc))
+
+@jax.jit
+def _wf_kernel(table_flat, resp, stmts, chals):
+    """com_j = prod ped_i^{resp_ji} - stmt_j^challenge, batched.
+
+    The Pedersen window table arrives as an argument — one compiled
+    program serves every parameter set of the same (n, bases) shape."""
+    fixed = cv.msm_flat(table_flat, resp)  # (B, n, 3, L)
+    sc = cv.scalar_mul(stmts, chals[:, None, :])  # (B, n, 3, L)
+    return cv.add(fixed, cv.neg(sc))
 
 
 # ===================================================================
@@ -215,6 +260,8 @@ class BatchedMembershipVerifier:
     def verify(self, proofs: Sequence[sigproof.MembershipProof],
                commitments: Sequence) -> np.ndarray:
         B = len(proofs)
+        if B == 0:
+            return np.zeros(0, dtype=bool)
         z = np.zeros((B, 4, 32), dtype=np.int32)  # value, hash, sig_bf, chal
         com_resp = np.zeros((B, 2, 32), dtype=np.int32)
         S_pts, R_pts, com_pts = [], [], []
@@ -229,20 +276,17 @@ class BatchedMembershipVerifier:
             S_pts.append(p.signature.S)
             R_pts.append(p.signature.R)
             com_pts.append(com)
-        t_aff, negSc, Rc, Pz, R_aff, com_val = self._kernel_pre(
-            jnp.asarray(z),
-            jnp.asarray(com_resp),
-            jnp.asarray(pr.encode_g1(S_pts)),
-            jnp.asarray(pr.encode_g1(R_pts)),
-            jnp.asarray(np.stack([cv.encode_point(c) for c in com_pts])),
+        t_aff, negSc, Rc, Pz, R_aff, com_val = _run_tiled(
+            _membership_pre_kernel,
+            z,
+            com_resp,
+            np.asarray(pr.encode_g1(S_pts)),
+            np.asarray(pr.encode_g1(R_pts)),
+            np.stack([cv.encode_point(c) for c in com_pts]),
+            consts=(self.pk_dev, self.tableP.flat, self.table2.flat),
         )
         # 4-leg pairing product via the compile-once staged tile programs
-        t_aff = np.asarray(t_aff)
-        Ps = np.stack(
-            [np.asarray(negSc), np.asarray(Rc), np.asarray(R_aff),
-             np.asarray(Pz)],
-            axis=1,
-        )  # (B, 4, 2, L)
+        Ps = np.stack([negSc, Rc, R_aff, Pz], axis=1)  # (B, 4, 2, L)
         Q_np = self.Q_np
         pk0_np = self.pk0_np
         Qs = np.stack(
@@ -264,30 +308,35 @@ class BatchedMembershipVerifier:
             out[i] = chal == p.challenge
         return out
 
-    @functools.partial(jax.jit, static_argnums=0)
-    def _kernel_pre(self, z, com_resp, S, R, com_jac):
-        """Group-side reconstruction; pairing runs via the staged tiles."""
-        B = z.shape[0]
-        # G2 term: t = PK1^{z_v} + PK2^{z_h}
-        bases = jnp.broadcast_to(self.pk_dev[1:3], (B, 2) + self.pk_dev.shape[1:])
-        terms = cv2.scalar_mul(bases, z[:, 0:2])
-        t = cv2.tree_sum(terms, axis=-4)
-        t_aff = cv2.to_affine_device(t)
-        # G1 sides: S^c, R^c (Jacobian scalar mul needs Jacobian input)
-        Sj = _affine_to_jac(S)
-        Rj = _affine_to_jac(R)
-        both = jnp.stack([Sj, Rj], axis=1)  # (B, 2, 3, L)
-        cc = jnp.broadcast_to(z[:, 3][:, None, :], (B, 2, 32))
-        powc = cv.scalar_mul(both, cc)
-        negSc_aff = _jac_to_affine(cv.neg(powc[:, 0]))
-        Rc_aff = _jac_to_affine(powc[:, 1])
-        Pz = _jac_to_affine(self.tableP.msm(z[:, 2:3]))  # P^{z_bf}
-        R_aff = _jac_to_affine(Rj)
-        # G1 commitment: ped0^{z_v} ped1^{z_cb} - com^c
-        fixed = self.table2.msm(com_resp)
-        comc = cv.scalar_mul(com_jac, z[:, 3])
-        com_val = cv.add(fixed, cv.neg(comc))
-        return t_aff, negSc_aff, Rc_aff, Pz, R_aff, com_val
+
+@jax.jit
+def _membership_pre_kernel(pk_dev, tableP_flat, table2_flat, z, com_resp,
+                           S, R, com_jac):
+    """Group-side reconstruction; pairing runs via the staged tiles.
+
+    All parameter tensors (PS public key, window tables) are arguments so
+    the program is shared across public-parameter sets."""
+    B = z.shape[0]
+    # G2 term: t = PK1^{z_v} + PK2^{z_h}
+    bases = jnp.broadcast_to(pk_dev[1:3], (B, 2) + pk_dev.shape[1:])
+    terms = cv2.scalar_mul(bases, z[:, 0:2])
+    t = cv2.tree_sum(terms, axis=-4)
+    t_aff = cv2.to_affine_device(t)
+    # G1 sides: S^c, R^c (Jacobian scalar mul needs Jacobian input)
+    Sj = _affine_to_jac(S)
+    Rj = _affine_to_jac(R)
+    both = jnp.stack([Sj, Rj], axis=1)  # (B, 2, 3, L)
+    cc = jnp.broadcast_to(z[:, 3][:, None, :], (B, 2, 32))
+    powc = cv.scalar_mul(both, cc)
+    negSc_aff = _jac_to_affine(cv.neg(powc[:, 0]))
+    Rc_aff = _jac_to_affine(powc[:, 1])
+    Pz = _jac_to_affine(cv.msm_flat(tableP_flat, z[:, 2:3]))  # P^{z_bf}
+    R_aff = _jac_to_affine(Rj)
+    # G1 commitment: ped0^{z_v} ped1^{z_cb} - com^c
+    fixed = cv.msm_flat(table2_flat, com_resp)
+    comc = cv.scalar_mul(com_jac, z[:, 3])
+    com_val = cv.add(fixed, cv.neg(comc))
+    return t_aff, negSc_aff, Rc_aff, Pz, R_aff, com_val
 
 
 # ===================================================================
@@ -402,9 +451,9 @@ class BatchedTransferVerifier:
                 )
             chals[li] = np.asarray(cv.encode_scalars([rpf.challenge]))[0]
 
-        com_tok, com_val = self._equality_kernel(
-            jnp.asarray(tok_resp), jnp.asarray(tok_stmt),
-            jnp.asarray(agg_resp), jnp.asarray(agg_stmt), jnp.asarray(chals),
+        com_tok, com_val = _run_tiled(
+            _equality_kernel, tok_resp, tok_stmt, agg_resp, agg_stmt,
+            chals, consts=(self.table3.flat, self.table2.flat),
         )
         com_tok_h = cv.decode_points(com_tok)
         com_val_h = cv.decode_points(com_val)
@@ -425,17 +474,19 @@ class BatchedTransferVerifier:
                 ok[i] = False
         return ok
 
-    @functools.partial(jax.jit, static_argnums=0)
-    def _equality_kernel(self, tok_resp, tok_stmt, agg_resp, agg_stmt, chals):
-        com_tok = cv.add(
-            self.table3.msm(tok_resp),
-            cv.neg(cv.scalar_mul(tok_stmt, chals[:, None, :])),
-        )
-        com_val = cv.add(
-            self.table2.msm(agg_resp),
-            cv.neg(cv.scalar_mul(agg_stmt, chals[:, None, :])),
-        )
-        return com_tok, com_val
+
+@jax.jit
+def _equality_kernel(table3_flat, table2_flat, tok_resp, tok_stmt, agg_resp,
+                     agg_stmt, chals):
+    com_tok = cv.add(
+        cv.msm_flat(table3_flat, tok_resp),
+        cv.neg(cv.scalar_mul(tok_stmt, chals[:, None, :])),
+    )
+    com_val = cv.add(
+        cv.msm_flat(table2_flat, agg_resp),
+        cv.neg(cv.scalar_mul(agg_stmt, chals[:, None, :])),
+    )
+    return com_tok, com_val
 
 
 @jax.jit

@@ -236,20 +236,29 @@ class FixedBaseTable:
             tables.reshape(self.nbases * DIGITS_PER_SCALAR, 1 << WINDOW_BITS, 3 * lb.NLIMBS)
         )
 
-    @functools.partial(jax.jit, static_argnums=0)
     def msm(self, scalars):
         """scalars: canonical limb tensor (..., nbases, NLIMBS) ->
         points (..., 3, NLIMBS) = sum_b scalar_b * base_b."""
-        shifts = jnp.arange(0, lb.RADIX_BITS, WINDOW_BITS, dtype=jnp.int32)
-        digs = (scalars[..., :, :, None] >> shifts) & ((1 << WINDOW_BITS) - 1)
-        # (..., nbases, NLIMBS * 2) -> (..., nbases*64)
-        digs = digs.reshape(digs.shape[:-3] + (self.nbases * DIGITS_PER_SCALAR,))
-        onehot = (digs[..., None] == jnp.arange(1 << WINDOW_BITS, dtype=jnp.int32)).astype(
-            jnp.int32
-        )  # (..., nbases*64, 16)
-        sel = jnp.einsum("...td,tdc->...tc", onehot, self.flat)
-        sel = sel.reshape(sel.shape[:-1] + (3, lb.NLIMBS))
-        return tree_sum(sel, axis=-3)
+        return msm_flat(self.flat, scalars)
+
+
+@jax.jit
+def msm_flat(flat, scalars):
+    """Fixed-base windowed multiexp against a table passed as an ARGUMENT
+    (not a baked constant), so the compiled program is shared across all
+    parameter sets — callers with different Pedersen bases / public keys
+    reuse one XLA executable per shape."""
+    nbases = flat.shape[0] // DIGITS_PER_SCALAR
+    shifts = jnp.arange(0, lb.RADIX_BITS, WINDOW_BITS, dtype=jnp.int32)
+    digs = (scalars[..., :, :, None] >> shifts) & ((1 << WINDOW_BITS) - 1)
+    # (..., nbases, NLIMBS * 2) -> (..., nbases*64)
+    digs = digs.reshape(digs.shape[:-3] + (nbases * DIGITS_PER_SCALAR,))
+    onehot = (digs[..., None] == jnp.arange(1 << WINDOW_BITS, dtype=jnp.int32)).astype(
+        jnp.int32
+    )  # (..., nbases*64, 16)
+    sel = jnp.einsum("...td,tdc->...tc", onehot, flat)
+    sel = sel.reshape(sel.shape[:-1] + (3, lb.NLIMBS))
+    return tree_sum(sel, axis=-3)
 
 
 @functools.lru_cache(maxsize=8)

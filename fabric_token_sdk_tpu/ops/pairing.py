@@ -360,14 +360,15 @@ def pairing_product_staged(Ps, Qs, inf_mask=None):
     """prod_k e(P_k, Q_k) per row via the compile-once tile programs.
 
     Ps: (B, K, 2, L), Qs: (B, K, 2, 2, L) Montgomery affine; inf_mask
-    (B, K) True legs contribute the identity. Returns (B, 6, 2, L) GT.
+    (B, K) True legs contribute the identity. Returns (B, 6, 2, L) GT as
+    a host numpy array.
     """
     Ps = np.asarray(Ps)
     Qs = np.asarray(Qs)
     B, K = Ps.shape[0], Ps.shape[1]
     L = Ps.shape[-1]
     if B == 0:
-        return jnp.zeros((0, 6, 2, L), dtype=jnp.int32)
+        return np.zeros((0, 6, 2, L), dtype=np.int32)
     N = B * K
     Pf = Ps.reshape(N, 2, L)
     Qf = Qs.reshape(N, 2, 2, L)
@@ -380,32 +381,36 @@ def pairing_product_staged(Ps, Qs, inf_mask=None):
         Pf = np.concatenate([Pf, np.broadcast_to(Pg, (pad, 2, L))])
         Qf = np.concatenate([Qf, np.broadcast_to(Qg, (pad, 2, 2, L))])
         mask = np.concatenate([mask, np.ones(pad, dtype=bool)])
-    outs = []
-    for t in range(0, N + pad, MILLER_TILE):
-        outs.append(
-            miller_loop(
-                jnp.asarray(Pf[t : t + MILLER_TILE]),
-                jnp.asarray(Qf[t : t + MILLER_TILE]),
+    # all inter-stage glue (concat/mask/reshape/pad) stays in numpy so the
+    # ONLY device programs are the three tile kernels — no per-shape
+    # concatenate/select programs on the accelerator
+    f = np.concatenate(
+        [
+            np.asarray(
+                miller_loop(
+                    jnp.asarray(Pf[t : t + MILLER_TILE]),
+                    jnp.asarray(Qf[t : t + MILLER_TILE]),
+                )
             )
-        )
-    f = jnp.concatenate(outs, axis=0)
-    one = jnp.broadcast_to(tw.fp12_ones(), f.shape).astype(jnp.int32)
-    f = jnp.where(jnp.asarray(mask)[:, None, None, None], one, f)
+            for t in range(0, N + pad, MILLER_TILE)
+        ],
+        axis=0,
+    )
+    one_np = np.asarray(tw.fp12_ones())
+    f[mask] = one_np
     f = f[:N].reshape(B, K, 6, 2, L)
     # pad rows BEFORE the product so both the per-K product program and
     # the final-exp program see only (FEXP_TILE, ...) shapes
     padB = (-B) % FEXP_TILE
     if padB:
-        ones = jnp.broadcast_to(
-            tw.fp12_ones(), (padB, K, 6, 2, L)
-        ).astype(jnp.int32)
-        f = jnp.concatenate([f, ones], axis=0)
+        f = np.concatenate(
+            [f, np.broadcast_to(one_np, (padB, K, 6, 2, L))], axis=0
+        )
     gts = [
-        final_exp(_product_rows(f[t : t + FEXP_TILE]))
+        np.asarray(final_exp(_product_rows(jnp.asarray(f[t : t + FEXP_TILE]))))
         for t in range(0, B + padB, FEXP_TILE)
     ]
-    out = jnp.concatenate(gts, axis=0)
-    return out[:B]
+    return np.concatenate(gts, axis=0)[:B]
 
 
 def decode_gt(arr):

@@ -31,13 +31,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Persistent XLA compilation cache: the batched pairing/curve programs are
-# compile-heavy; caching cuts repeat suite runs from tens of minutes to
-# minutes. Safe to share across processes (content-addressed).
+# The package ships without an installer; the repo root on sys.path is
+# what makes `fabric_token_sdk_tpu` (and `import __graft_entry__`)
+# importable from any pytest invocation directory.
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-from fabric_token_sdk_tpu import jaxcache
 
-jaxcache.enable()
+# Persistent XLA compilation cache is configured centrally in
+# fabric_token_sdk_tpu/ops/__init__.py (~/.cache/fts_tpu_jax); kernels are
+# row-tiled (crypto/batch.py ROW_TILE) and setup fixtures seeded so cache
+# entries hit across runs.
 
 import random
 
