@@ -12,6 +12,14 @@ TPU under the driver; CPU fallback if the tunnel is down). Proof
 generation happens on the host; the measured quantity is block
 verification: batched WF + range-equality + membership(4 pairing products
 each) kernels plus host Fiat-Shamir re-hashing.
+
+Observability: the run emits phase-stamped heartbeat lines to stderr
+(`[fts-bench] phase=warmup_compile elapsed=134s total=250s`) and flushes
+a metrics sidecar JSON (per-phase wall times, compile/cache counters,
+pipeline histograms) on exit, SIGTERM, or the internal deadline — so
+even a timed-out run (rc=124) leaves a full accounting. Sidecar path:
+$FTS_METRICS_SIDECAR (default BENCH.metrics.json). Inspect with
+`python cmd/ftsmetrics.py show BENCH.metrics.json`.
 """
 
 from __future__ import annotations
@@ -25,17 +33,51 @@ import time
 # Persistent XLA compilation cache is configured centrally in
 # fabric_token_sdk_tpu/ops/__init__.py (~/.cache/fts_tpu_jax).
 
+# set once the result JSON has been printed; the deadline watchdog checks
+# it so a completed (or merely slow-but-healthy) run is never clobbered
+# by the CPU fallback re-exec
+_done = threading.Event()
+
+
+def _metrics():
+    from fabric_token_sdk_tpu.utils import metrics
+
+    return metrics
+
+
+def _sidecar_path() -> str:
+    return os.environ.get("FTS_METRICS_SIDECAR", "BENCH.metrics.json")
+
+
+def _deadline_sidecar_path() -> str:
+    """Distinct path for the pre-re-exec accounting: the CPU child reuses
+    the main sidecar path and would otherwise overwrite the record of
+    where the accelerator attempt stalled."""
+    p = _sidecar_path()
+    if p.endswith(".metrics.json"):
+        return p[: -len(".metrics.json")] + ".deadline.metrics.json"
+    return p + ".deadline.json"
+
 
 def _reexec_cpu() -> None:
     """Restart this process pinned to local CPU (axon tunnel unhealthy)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the fallback child must complete at all costs — do not let it
+    # inherit the deadline that just killed the accelerator attempt
+    env.pop("FTS_BENCH_DEADLINE", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["_FTS_BENCH_REEXEC"] = "1"
     env["PYTHONPATH"] = ":".join(
         p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
     )
     if not os.environ.get("_FTS_BENCH_REEXEC"):
+        # execve skips atexit: record the accelerator attempt before it is
+        # replaced — the CPU child reuses (and overwrites) the main path
+        mx = _metrics()
+        mx.REGISTRY.set_meta("reexec_to_cpu", True)
+        mx.flush_sidecar()
+        mx.flush_sidecar(_deadline_sidecar_path())
         os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -64,23 +106,45 @@ def _platform_guard() -> str:
 
 def _arm_deadline(platform: str) -> None:
     """A sick tunnel can pass the device probe yet hang the first compile
-    or transfer forever. On the axon platform, arm a hard deadline: if the
-    benchmark hasn't printed its JSON by then, re-exec pinned to CPU so
-    the driver always records a number."""
-    if platform == "cpu":
-        return
+    or transfer forever. Arm a hard deadline: if the benchmark hasn't
+    printed its JSON by then, flush the metrics sidecar (so the run is
+    not a zero-information outcome), then on the axon platform re-exec
+    pinned to CPU so the driver always records a number."""
+    if platform == "cpu" and "FTS_BENCH_DEADLINE" not in os.environ:
+        return  # CPU runs have no fallback to arm unless explicitly asked
     deadline = float(os.environ.get("FTS_BENCH_DEADLINE", "2400"))
 
     def watchdog():
-        time.sleep(deadline)
-        _reexec_cpu()
-        os._exit(3)  # re-exec refused (already CPU): fail loudly
+        if _done.wait(timeout=deadline):
+            return  # JSON already printed: never clobber a finished run
+        mx = _metrics()
+        mx.REGISTRY.set_meta("deadline_fired_s", deadline)
+        print(
+            f"[fts-bench] DEADLINE after {deadline:.0f}s on platform="
+            f"{platform}: flushing metrics sidecar and "
+            + ("re-exec'ing on CPU" if platform != "cpu" else "exiting 124"),
+            file=sys.stderr,
+            flush=True,
+        )
+        if platform != "cpu":
+            _reexec_cpu()  # owns the pre-exec sidecar flushes; no return
+        mx.flush_sidecar()  # already CPU (or re-exec refused): record...
+        os._exit(124)  # ...then fail loudly
 
     threading.Thread(target=watchdog, daemon=True).start()
 
 
 def main() -> None:
+    mx = _metrics()
+    mx.enable(True)
+    mx.install_sidecar(_sidecar_path())
+    mx.REGISTRY.set_meta("entry", "bench.py")
+    mx.REGISTRY.set_meta("argv", " ".join(sys.argv))
+    hb = mx.Heartbeat("fts-bench").start()
+
+    hb.set_phase("platform_probe")
     platform = _platform_guard()
+    mx.REGISTRY.set_meta("platform", platform)
     _arm_deadline(platform)
     import random
 
@@ -93,11 +157,13 @@ def main() -> None:
     base = 16
     exponent = 2
     rng = random.Random(1234)
+    hb.set_phase("setup", base=base, exponent=exponent)
     t0 = time.time()
     pp = setup(base=base, exponent=exponent, rng=rng)
     setup_s = time.time() - t0
 
     # build B two-in/two-out transfers (host proving)
+    hb.set_phase("provegen", batch=B)
     t0 = time.time()
     txs = []
     for i in range(B):
@@ -109,6 +175,7 @@ def main() -> None:
 
     verifier = batch_mod.BatchedTransferVerifier(pp)
     # warmup (compiles device programs)
+    hb.set_phase("warmup_compile", batch=B)
     t0 = time.time()
     ok = verifier.verify(txs)
     warm_s = time.time() - t0
@@ -116,12 +183,18 @@ def main() -> None:
 
     # timed runs
     runs = int(os.environ.get("FTS_BENCH_RUNS", "3"))
+    hb.set_phase("timed_runs", runs=runs)
     t0 = time.time()
     for _ in range(runs):
         ok = verifier.verify(txs)
     elapsed = time.time() - t0
     rate = B * runs / elapsed
 
+    hb.set_phase("done")
+    mx.gauge("bench.throughput_tx_per_s").set(round(rate, 2))
+    mx.gauge("bench.warmup_s").set(round(warm_s, 3))
+    mx.gauge("bench.provegen_s").set(round(gen_s, 3))
+    mx.gauge("bench.setup_s").set(round(setup_s, 3))
     print(
         json.dumps(
             {
@@ -136,8 +209,12 @@ def main() -> None:
                 "provegen_s": round(gen_s, 1),
                 "setup_s": round(setup_s, 1),
             }
-        )
+        ),
+        flush=True,
     )
+    _done.set()
+    hb.stop()
+    mx.flush_sidecar()
 
 
 if __name__ == "__main__":

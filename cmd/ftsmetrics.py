@@ -1,0 +1,203 @@
+"""Pretty-print / diff `*.metrics.json` sidecar dumps.
+
+Usage:
+    python cmd/ftsmetrics.py show BENCH.metrics.json
+    python cmd/ftsmetrics.py show --prometheus BENCH.metrics.json
+    python cmd/ftsmetrics.py diff BENCH_r05.metrics.json BENCH_r06.metrics.json
+
+The sidecar format is whatever `utils/metrics.py` `Registry.snapshot()`
+emits: meta, phase timeline, counters, gauges, histograms, span summary.
+See docs/OBSERVABILITY.md for the metric-name taxonomy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 60:
+        return f"{v / 60:.1f}m"
+    if v >= 1:
+        return f"{v:.1f}s"
+    return f"{v * 1000:.1f}ms"
+
+
+def _print_kv(title: str, rows, fmt=str) -> None:
+    if not rows:
+        return
+    print(f"\n{title}")
+    width = max(len(k) for k, _ in rows)
+    for k, v in rows:
+        print(f"  {k:<{width}}  {fmt(v)}")
+
+
+def show(path: str, prometheus: bool = False) -> None:
+    d = _load(path)
+    if prometheus:
+        # re-serialize counters/gauges through a scratch registry so one
+        # exporter owns that part of the text format
+        from fabric_token_sdk_tpu.utils.metrics import Registry, _prom_name, _prom_num
+
+        reg = Registry()
+        for name, v in d.get("counters", {}).items():
+            reg.counter(name).inc(v)
+        for name, v in d.get("gauges", {}).items():
+            reg.gauge(name).set(v)
+        sys.stdout.write(reg.to_prometheus())
+        # histograms come from the snapshot dict directly (the sidecar
+        # stores per-bucket counts for the non-empty buckets only)
+        lines = []
+        for name, h in sorted(d.get("histograms", {}).items()):
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            finite = {
+                float(le): c
+                for le, c in h.get("buckets", {}).items()
+                if le != "+Inf"
+            }
+            for le in sorted(finite):
+                cum += finite[le]
+                lines.append(f'{m}_bucket{{le="{_prom_num(le)}"}} {cum}')
+            cum += h.get("buckets", {}).get("+Inf", 0)
+            lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{m}_sum {_prom_num(h.get('sum', 0))}")
+            lines.append(f"{m}_count {h.get('count', 0)}")
+        if lines:
+            sys.stdout.write("\n".join(lines) + "\n")
+        return
+
+    print(f"== {path}")
+    meta = d.get("meta", {})
+    if meta:
+        _print_kv("meta", sorted(meta.items()))
+
+    phases = d.get("phases", [])
+    if phases:
+        print("\nphases")
+        for p in phases:
+            el = p.get("elapsed_s")
+            el_s = _fmt_s(el) if el is not None else "(unfinished)"
+            attrs = p.get("attrs", {})
+            extra = "".join(f" {k}={v}" for k, v in attrs.items())
+            print(f"  {p['name']:<18} {el_s:>10}{extra}")
+        total = sum(p.get("elapsed_s", 0.0) for p in phases)
+        print(f"  {'TOTAL':<18} {_fmt_s(total):>10}")
+
+    _print_kv("counters", sorted(d.get("counters", {}).items()))
+    _print_kv(
+        "gauges",
+        sorted(d.get("gauges", {}).items()),
+        fmt=lambda v: f"{v:g}",
+    )
+
+    hists = d.get("histograms", {})
+    if hists:
+        print("\nhistograms (count / mean / max / sum)")
+        width = max(len(k) for k in hists)
+        for name, h in sorted(hists.items()):
+            if not h.get("count"):
+                continue
+            print(
+                f"  {name:<{width}}  n={h['count']:<6} "
+                f"mean={_fmt_s(h.get('mean', 0)):>8} "
+                f"max={_fmt_s(h.get('max', 0)):>8} "
+                f"sum={_fmt_s(h.get('sum', 0)):>8}"
+            )
+
+    spans = d.get("span_summary", {})
+    if spans:
+        print("\nspan summary (by total time)")
+        width = max(len(k) for k in spans)
+        for name, a in sorted(
+            spans.items(), key=lambda kv: -kv[1].get("total_s", 0)
+        ):
+            print(
+                f"  {name:<{width}}  n={a['count']:<6} "
+                f"total={_fmt_s(a['total_s']):>8}"
+            )
+
+
+def diff(path_a: str, path_b: str) -> None:
+    a, b = _load(path_a), _load(path_b)
+    print(f"== {path_a} -> {path_b}")
+
+    def _delta_rows(key, fmt_delta):
+        names = sorted(set(a.get(key, {})) | set(b.get(key, {})))
+        rows = []
+        for n in names:
+            va = a.get(key, {}).get(n, 0)
+            vb = b.get(key, {}).get(n, 0)
+            if va != vb:
+                rows.append((n, fmt_delta(va, vb)))
+        return rows
+
+    _print_kv(
+        "counters (old -> new)",
+        _delta_rows("counters", lambda x, y: f"{x} -> {y}  ({y - x:+d})"),
+    )
+    _print_kv(
+        "gauges (old -> new)",
+        _delta_rows("gauges", lambda x, y: f"{x:g} -> {y:g}"),
+    )
+
+    ha, hb = a.get("histograms", {}), b.get("histograms", {})
+    rows = []
+    for n in sorted(set(ha) | set(hb)):
+        ca = ha.get(n, {}).get("count", 0)
+        cb = hb.get(n, {}).get("count", 0)
+        sa = ha.get(n, {}).get("sum", 0.0)
+        sb = hb.get(n, {}).get("sum", 0.0)
+        if (ca, sa) != (cb, sb):
+            rows.append(
+                (n, f"n {ca} -> {cb}, sum {_fmt_s(sa)} -> {_fmt_s(sb)}")
+            )
+    _print_kv("histograms (old -> new)", rows)
+
+    for label, d_ in (("old", a), ("new", b)):
+        phases = d_.get("phases", [])
+        if phases:
+            line = ", ".join(
+                f"{p['name']}={_fmt_s(p['elapsed_s'])}"
+                for p in phases
+                if "elapsed_s" in p
+            )
+            print(f"\nphases[{label}]: {line}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ftsmetrics", description=__doc__.splitlines()[0]
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="pretty-print one sidecar")
+    p_show.add_argument("path")
+    p_show.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="emit Prometheus text exposition instead of the human view",
+    )
+    p_diff = sub.add_parser("diff", help="diff two sidecars")
+    p_diff.add_argument("old")
+    p_diff.add_argument("new")
+    args = ap.parse_args(argv)
+    if args.cmd == "show":
+        show(args.path, prometheus=args.prometheus)
+    else:
+        diff(args.old, args.new)
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+    sys.exit(main())

@@ -29,6 +29,7 @@ from .transfer import TransferProof
 from .wellformedness import TransferWF, challenge_transfer_wf
 from ..ops import curve as cv, curve2 as cv2, pairing as pr, tower as tw
 from ..ops.field import FP
+from ..utils import metrics as mx
 
 
 # -------------------------------------------------------------- tiling
@@ -39,6 +40,20 @@ from ..ops.field import FP
 # same cached programs.
 
 ROW_TILE = 8
+
+
+def _spanned(name):
+    """Wrap a verify method in a metrics span (no-op when disabled)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            with mx.span(name):
+                return fn(*args, **kw)
+
+        return wrapper
+
+    return deco
 
 
 def _run_tiled(kernel, *arrays, consts=()):
@@ -54,6 +69,9 @@ def _run_tiled(kernel, *arrays, consts=()):
         arrays = tuple(
             np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in arrays
         )
+    mx.counter("batch.tiled.calls").inc()
+    mx.counter("batch.tiled.rows").inc(B)
+    mx.counter("batch.tiled.tiles").inc((B + pad) // ROW_TILE)
     outs = [
         kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
         for t in range(0, B + pad, ROW_TILE)
@@ -80,11 +98,13 @@ class BatchedPSVerifier:
         self.pk_dev = jnp.asarray(cv2.encode_points(self.pk_host))  # (l+2,3,2,L)
         self.Q_aff = jnp.asarray(pr.encode_g2([Q]))[0]  # (2,2,L)
 
+    @_spanned("batch.ps.verify")
     def verify(self, messages_rows: Sequence[Sequence[int]], sigs) -> np.ndarray:
         """-> bool array (B,). Raises nothing; invalid rows are False."""
         B = len(sigs)
         if B == 0:
             return np.zeros(0, dtype=bool)
+        mx.counter("batch.ps.sigs").inc(B)
         l = len(self.pk_host) - 2
         scal = np.zeros((B, l + 1, 32), dtype=np.int32)
         negS, R = [], []
@@ -144,10 +164,12 @@ class BatchedWFVerifier:
         self.pp = pp
         self.table = cv.FixedBaseTable(pp.ped_params)
 
+    @_spanned("batch.wf.verify")
     def verify(self, txs: Sequence[Tuple[list, list, bytes]]) -> np.ndarray:
         """txs: (inputs, outputs, wf_bytes) with uniform shapes.
         Returns bool array (B,)."""
         B = len(txs)
+        mx.counter("batch.wf.txs").inc(B)
         n_in = len(txs[0][0])
         n_out = len(txs[0][1])
         n = n_in + n_out + 2  # + the two aggregate statements
@@ -257,11 +279,13 @@ class BatchedMembershipVerifier:
         self.table2 = cv.FixedBaseTable(self.ped2)
         self.tableP = cv.FixedBaseTable([self.P])
 
+    @_spanned("batch.membership.verify")
     def verify(self, proofs: Sequence[sigproof.MembershipProof],
                commitments: Sequence) -> np.ndarray:
         B = len(proofs)
         if B == 0:
             return np.zeros(0, dtype=bool)
+        mx.counter("batch.membership.proofs").inc(B)
         z = np.zeros((B, 4, 32), dtype=np.int32)  # value, hash, sig_bf, chal
         com_resp = np.zeros((B, 2, 32), dtype=np.int32)
         S_pts, R_pts, com_pts = [], [], []
@@ -358,11 +382,13 @@ class BatchedTransferVerifier:
         self.table3 = self.wf.table  # ped 3-base table
         self.table2 = self.membership.table2  # ped[:2]
 
+    @_spanned("batch.transfer.verify")
     def verify(self, txs: Sequence[Tuple[list, list, bytes]]) -> np.ndarray:
         """txs: (inputs, outputs, transfer_proof_bytes), uniform shapes.
         Returns bool array (B,). 1-in/1-out txs skip range (reference
         transfer.go:55-59)."""
         B = len(txs)
+        mx.counter("batch.transfer.txs").inc(B)
         n_in, n_out = len(txs[0][0]), len(txs[0][1])
         proofs = []
         ok = np.ones(B, dtype=bool)

@@ -634,6 +634,13 @@ pairing_product_py = pairing_product
 NATIVE_G1 = False
 
 
+def _selfcheck_fail(reason: str) -> None:
+    from ..utils import metrics as _mx
+
+    _mx.counter("native.selfcheck.fail").inc()
+    _mx.REGISTRY.set_meta("native.selfcheck.last_failure", reason)
+
+
 def _install_native() -> None:
     global g1_mul, g1_multiexp, g1_sum, NATIVE_G1
     global g2_mul, g2_multiexp, g2_sum, pairing, pairing_product
@@ -646,13 +653,32 @@ def _install_native() -> None:
 
         if not _nb.available():
             return
-        # round-trip self-checks before trusting the build
+        # Round-trip self-checks before trusting the build. Every function
+        # family the swap-in covers is exercised: a toolchain-specific
+        # miscompile confined to the G2 or multi-leg pairing-product path
+        # must not be silently adopted (the pytest differential suite does
+        # not run at import time).
         if _nb.g1_mul(G1_GEN, 12345) != g1_mul_py(G1_GEN, 12345):
+            _selfcheck_fail("g1_mul")  # pragma: no cover
+            return  # pragma: no cover
+        if _nb.g2_mul(G2_GEN, 98765) != g2_mul_py(G2_GEN, 98765):
+            _selfcheck_fail("g2_mul")  # pragma: no cover
             return  # pragma: no cover
         if _nb.pairing(G1_GEN, G2_GEN) != pairing_py(G1_GEN, G2_GEN):
+            _selfcheck_fail("pairing")  # pragma: no cover
             return  # pragma: no cover
-    except Exception:  # pragma: no cover
+        # e(P,Q) * e(-P,Q) == 1: exercises the multi-leg Miller product
+        # and shared final exponentiation.
+        if _nb.pairing_product([(G1_GEN, G2_GEN), (g1_neg(G1_GEN), G2_GEN)]) != FP12_ONE:
+            _selfcheck_fail("pairing_product")  # pragma: no cover
+            return  # pragma: no cover
+    except Exception as e:  # pragma: no cover
+        _selfcheck_fail(f"exception: {e}")
         return
+
+    from ..utils import metrics as _mx
+
+    _mx.counter("native.selfcheck.pass").inc()
 
     def _g1_sum(points):
         return _nb.g1_sum(list(points))
@@ -680,6 +706,7 @@ def _install_native() -> None:
     pairing = _pairing
     pairing_product = _pairing_product
     NATIVE_G1 = True
+    _mx.gauge("native.installed").set(1)
 
 
 _install_native()
@@ -692,10 +719,14 @@ def g1_mul_batch(points, scalars):
         raise ValueError(
             f"mul_batch length mismatch: {len(points)} != {len(scalars)}"
         )
+    from ..utils import metrics as _mx
+
     if NATIVE_G1:
         from ..native import bn254py as _nb
 
+        _mx.counter("hostmath.g1_mul_batch.native").inc()
         return _nb.g1_mul_batch(points, scalars)
+    _mx.counter("hostmath.g1_mul_batch.python").inc()
     return [g1_mul_py(p, k) for p, k in zip(points, scalars)]
 
 

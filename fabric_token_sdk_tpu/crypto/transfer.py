@@ -14,6 +14,7 @@ from . import hostmath as hm, rangeproof, wellformedness as wf
 from .setup import PublicParams
 from .serialization import guard, dumps, loads
 from .token import TokenDataWitness
+from ..utils import metrics as mx
 
 
 @dataclass
@@ -76,10 +77,16 @@ class TransferProver:
             )
 
     def prove(self) -> bytes:
-        return TransferProof(
-            wf=self.wf_prover.prove(),
-            range_correctness=self.range_prover.prove() if self.range_prover else None,
-        ).to_bytes()
+        # total proves = path.native + path.python
+        mx.counter(
+            "transfer.prove.path.native" if hm.NATIVE_G1
+            else "transfer.prove.path.python"
+        ).inc()
+        with mx.span("transfer.prove"):
+            return TransferProof(
+                wf=self.wf_prover.prove(),
+                range_correctness=self.range_prover.prove() if self.range_prover else None,
+            ).to_bytes()
 
 
 class TransferVerifier:
@@ -94,9 +101,11 @@ class TransferVerifier:
 
     @guard
     def verify(self, raw: bytes) -> None:
-        proof = TransferProof.from_bytes(raw)
-        self.wf_verifier.verify(proof.wf)
-        if self.range_verifier is not None:
-            if proof.range_correctness is None:
-                raise ValueError("invalid transfer proof: missing range proof")
-            self.range_verifier.verify(proof.range_correctness)
+        mx.counter("transfer.verify.count").inc()
+        with mx.span("transfer.verify"):
+            proof = TransferProof.from_bytes(raw)
+            self.wf_verifier.verify(proof.wf)
+            if self.range_verifier is not None:
+                if proof.range_correctness is None:
+                    raise ValueError("invalid transfer proof: missing range proof")
+                self.range_verifier.verify(proof.range_correctness)

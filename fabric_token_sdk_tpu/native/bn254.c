@@ -303,7 +303,20 @@ static void g1_add(g1 *r, const g1 *p, const g1 *q) {
   r->Z = newZ;
 }
 
-/* 4-bit fixed-window scalar multiplication; scalar as plain LE limbs. */
+/* 4-bit fixed-window scalar multiplication; scalar as plain LE limbs.
+ *
+ * TIMING CAVEAT: this is VARIABLE-TIME — the per-digit branch (`if (d)`),
+ * the `started` skip of leading zero windows, and the non-constant-time
+ * fp_reduce all leak scalar-dependent timing. That was acceptable while
+ * the native library served only the host VERIFIER (public scalars), but
+ * hostmath.py now installs it as the fast path for proof generation and
+ * signing too, where scalars are secrets (blinding factors, signing
+ * keys). This matches the equally variable-time pure-Python fallback, so
+ * it is not a regression — but if the threat model ever includes
+ * co-located attackers able to measure wall time, a constant-time ladder
+ * (fixed window read via table scan + unconditional add-and-select) must
+ * replace this for prover-side calls. The same applies to g2_scalar_mul.
+ */
 static void g1_scalar_mul(g1 *r, const g1 *p, const u64 k[4]) {
   g1 table[16];
   g1_set_inf(&table[0]);

@@ -31,5 +31,92 @@ try:
 except Exception:  # older jax without the knobs
     pass
 
+
+# ---------------------------------------------------------- observability
+#
+# Compile/cache instrumentation: every XLA compile and persistent-cache
+# hit/miss/load-failure lands in the metrics registry. This is the signal
+# that diagnoses a silent rc=124 (unbounded recompiles after cache-load
+# failures) in one read of the sidecar:
+#   jax.core.compile.backend_compile_duration.seconds  — per-program wall
+#     time histogram; its `count` IS the distinct-compiled-program count
+#   jax.compilation_cache.cache_hits / cache_misses    — persistent cache
+#   jax.cache.load_failures                            — AOT entries that
+#     exist but refuse to load (e.g. cpu_aot_loader machine mismatch)
+
+
+def _install_jax_monitoring() -> None:
+    from ..utils import metrics as _mx
+
+    def _event_name(raw: str) -> str:
+        return "jax." + raw.strip("/").replace("/", ".").removeprefix("jax.")
+
+    try:
+        from jax import monitoring as _mon
+
+        def _on_event(name, **kw):
+            _mx.REGISTRY.counter(_event_name(name)).inc()
+
+        def _on_duration(name, duration, **kw):
+            # the histogram's own `count` is the event count — e.g. the
+            # backend_compile histogram count IS the distinct-program count
+            _mx.REGISTRY.histogram(_event_name(name) + ".seconds").observe(duration)
+
+        _mon.register_event_listener(_on_event)
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # older jax without monitoring
+        pass
+
+    # Persistent-cache load failures surface as `warnings.warn(...)` from
+    # jax._src.compiler (`Error reading persistent compilation cache
+    # entry ...`) — chain-wrap showwarning to count them. The message
+    # includes the module name, so the once-per-location warning filter
+    # still counts each failing program once.
+    import warnings as _warnings
+
+    _prev_showwarning = _warnings.showwarning
+
+    def _classify_cache_error(text: str):
+        # reads and writes fail for different reasons (unloadable entry
+        # vs. full/read-only dir) — misfiling one as the other sends the
+        # rc=124 investigation the wrong way
+        if "persistent compilation cache" not in text:
+            return None
+        return (
+            "jax.cache.write_failures"
+            if "Error writing" in text
+            else "jax.cache.load_failures"
+        )
+
+    def _count_cache_error(text: str) -> None:
+        name = _classify_cache_error(text)
+        if name:
+            _mx.REGISTRY.counter(name).inc()
+            _mx.REGISTRY.set_meta(name.replace("failures", "last_failure"),
+                                  text[:500])
+
+    def _counting_showwarning(message, category, filename, lineno,
+                              file=None, line=None):
+        _count_cache_error(str(message))
+        _prev_showwarning(message, category, filename, lineno, file, line)
+
+    _warnings.showwarning = _counting_showwarning
+
+    # ... and some jax versions route them through logging instead.
+    import logging as _logging
+
+    class _CacheFailureCounter(_logging.Handler):
+        def emit(self, record):
+            if record.levelno >= _logging.WARNING:
+                # same read/write classification as the showwarning hook
+                _count_cache_error(record.getMessage())
+
+    _h = _CacheFailureCounter(level=_logging.WARNING)
+    for _name in ("jax._src.compilation_cache", "jax._src.compiler"):
+        _logging.getLogger(_name).addHandler(_h)
+
+
+_install_jax_monitoring()
+
 from . import limbs  # noqa: F401
 from .field import FP, FR, FieldSpec  # noqa: F401

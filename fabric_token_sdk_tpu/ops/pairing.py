@@ -31,6 +31,7 @@ from jax import lax
 from . import limbs as lb, tower as tw
 from .field import FP
 from ..crypto import hostmath as hm
+from ..utils import metrics as mx
 
 # ---------------------------------------------------------------- constants
 
@@ -381,35 +382,45 @@ def pairing_product_staged(Ps, Qs, inf_mask=None):
         Pf = np.concatenate([Pf, np.broadcast_to(Pg, (pad, 2, L))])
         Qf = np.concatenate([Qf, np.broadcast_to(Qg, (pad, 2, 2, L))])
         mask = np.concatenate([mask, np.ones(pad, dtype=bool)])
-    # all inter-stage glue (concat/mask/reshape/pad) stays in numpy so the
-    # ONLY device programs are the three tile kernels — no per-shape
-    # concatenate/select programs on the accelerator
-    f = np.concatenate(
-        [
-            np.asarray(
-                miller_loop(
-                    jnp.asarray(Pf[t : t + MILLER_TILE]),
-                    jnp.asarray(Qf[t : t + MILLER_TILE]),
-                )
+    mx.counter("pairing.staged.calls").inc()
+    mx.counter("pairing.staged.rows").inc(B)
+    mx.counter("pairing.staged.legs").inc(N)
+    mx.counter("pairing.staged.miller_tiles").inc((N + pad) // MILLER_TILE)
+    with mx.span("pairing.product_staged", rows=B, legs_per_row=K):
+        # all inter-stage glue (concat/mask/reshape/pad) stays in numpy so
+        # the ONLY device programs are the three tile kernels — no
+        # per-shape concatenate/select programs on the accelerator
+        with mx.timed("pairing.staged.miller.seconds"):
+            f = np.concatenate(
+                [
+                    np.asarray(
+                        miller_loop(
+                            jnp.asarray(Pf[t : t + MILLER_TILE]),
+                            jnp.asarray(Qf[t : t + MILLER_TILE]),
+                        )
+                    )
+                    for t in range(0, N + pad, MILLER_TILE)
+                ],
+                axis=0,
             )
-            for t in range(0, N + pad, MILLER_TILE)
-        ],
-        axis=0,
-    )
-    one_np = np.asarray(tw.fp12_ones())
-    f[mask] = one_np
-    f = f[:N].reshape(B, K, 6, 2, L)
-    # pad rows BEFORE the product so both the per-K product program and
-    # the final-exp program see only (FEXP_TILE, ...) shapes
-    padB = (-B) % FEXP_TILE
-    if padB:
-        f = np.concatenate(
-            [f, np.broadcast_to(one_np, (padB, K, 6, 2, L))], axis=0
-        )
-    gts = [
-        np.asarray(final_exp(_product_rows(jnp.asarray(f[t : t + FEXP_TILE]))))
-        for t in range(0, B + padB, FEXP_TILE)
-    ]
+        one_np = np.asarray(tw.fp12_ones())
+        f[mask] = one_np
+        f = f[:N].reshape(B, K, 6, 2, L)
+        # pad rows BEFORE the product so both the per-K product program and
+        # the final-exp program see only (FEXP_TILE, ...) shapes
+        padB = (-B) % FEXP_TILE
+        if padB:
+            f = np.concatenate(
+                [f, np.broadcast_to(one_np, (padB, K, 6, 2, L))], axis=0
+            )
+        mx.counter("pairing.staged.fexp_tiles").inc((B + padB) // FEXP_TILE)
+        with mx.timed("pairing.staged.product_fexp.seconds"):
+            gts = [
+                np.asarray(
+                    final_exp(_product_rows(jnp.asarray(f[t : t + FEXP_TILE])))
+                )
+                for t in range(0, B + padB, FEXP_TILE)
+            ]
     return np.concatenate(gts, axis=0)[:B]
 
 

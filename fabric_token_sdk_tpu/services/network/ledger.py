@@ -19,6 +19,7 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator
 from ...models.token import ID
+from ...utils import metrics as mx
 from ...utils.tracing import tracer
 
 
@@ -94,12 +95,14 @@ class Network:
         with tracer.span("network.submit", tx=tx_id):
             with self._lock:
                 if tx_id in self._status:
+                    mx.counter("network.submit.resubmissions").inc()
                     return self._status[tx_id]  # idempotent resubmission
                 commit_time = time.time()
                 try:
-                    result = self.validator.validate(
-                        request, self._resolve_locked, now=commit_time
-                    )
+                    with mx.span("network.validate", tx=tx_id):
+                        result = self.validator.validate(
+                            request, self._resolve_locked, now=commit_time
+                        )
                     # MVCC conflict check happens inside _resolve_locked;
                     # apply atomically
                     for token_id in result.spent:
@@ -111,10 +114,13 @@ class Network:
                             self._state[ID(tx_id, out_index).key()] = raw
                             out_index += 1
                     event = FinalityEvent(tx_id, TxStatus.VALID)
+                    mx.counter("network.tx.valid").inc()
                 except ValidationError as e:
                     event = FinalityEvent(tx_id, TxStatus.INVALID, str(e))
+                    mx.counter("network.tx.invalid").inc()
                 self._status[tx_id] = event
                 self._blocks.append(Block(len(self._blocks), [tx_id], commit_time))
+                mx.gauge("network.height").set(len(self._blocks))
             for listener in self._listeners:
                 listener(event, request)
             return event

@@ -13,6 +13,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...models.token import ID, UnspentToken
+from ...utils import metrics as mx
 from ..vault.vault import Vault
 
 
@@ -68,34 +69,46 @@ class Selector:
 
         Returns (ids, total). Raises InsufficientFunds / SelectorTimeout.
         """
-        for attempt in range(self.retries):
-            picked: List[ID] = []
-            total = 0
-            saw_busy = False
-            for ut in self.vault.unspent_tokens(token_type):
+        t0 = time.monotonic()
+        try:
+            for attempt in range(self.retries):
+                picked: List[ID] = []
+                total = 0
+                saw_busy = False
+                for ut in self.vault.unspent_tokens(token_type):
+                    if total >= amount:
+                        break
+                    if not self.locker.try_lock(ut.id, self.tx_id):
+                        # tokens this SAME tx already earmarked can never
+                        # free up before it completes: not retryable
+                        # contention
+                        if self.locker.holder(ut.id) != self.tx_id:
+                            saw_busy = True
+                            mx.counter("selector.lock.busy").inc()
+                        continue
+                    mx.counter("selector.lock.acquired").inc()
+                    picked.append(ut.id)
+                    total += int(ut.quantity)
                 if total >= amount:
-                    break
-                if not self.locker.try_lock(ut.id, self.tx_id):
-                    # tokens this SAME tx already earmarked can never free up
-                    # before it completes: not retryable contention
-                    if self.locker.holder(ut.id) != self.tx_id:
-                        saw_busy = True
-                    continue
-                picked.append(ut.id)
-                total += int(ut.quantity)
-            if total >= amount:
-                return picked, total
-            # not enough: release and maybe retry (tokens may unlock)
-            for i in picked:
-                self.locker.unlock(i)
-            if not saw_busy:
-                raise InsufficientFunds(
-                    f"insufficient funds: need {amount} of [{token_type}]"
-                )
-            time.sleep(self.backoff_s * (attempt + 1))
-        raise SelectorTimeout(
-            f"token selection timed out: tokens busy for [{token_type}]"
-        )
+                    return picked, total
+                # not enough: release and maybe retry (tokens may unlock)
+                for i in picked:
+                    self.locker.unlock(i)
+                if not saw_busy:
+                    mx.counter("selector.insufficient_funds").inc()
+                    raise InsufficientFunds(
+                        f"insufficient funds: need {amount} of [{token_type}]"
+                    )
+                mx.counter("selector.retry").inc()
+                time.sleep(self.backoff_s * (attempt + 1))
+            mx.counter("selector.timeout").inc()
+            raise SelectorTimeout(
+                f"token selection timed out: tokens busy for [{token_type}]"
+            )
+        finally:
+            mx.histogram("selector.select.seconds").observe(
+                time.monotonic() - t0
+            )
 
     def unselect(self, ids: List[ID]) -> None:
         for i in ids:

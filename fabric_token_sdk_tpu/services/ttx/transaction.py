@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...models.token import ID
+from ...utils import metrics as mx
 from ..network.ledger import FinalityEvent, TxStatus
 from ..ttxdb.db import MovementDirection, TxType
 from .party import Party
@@ -33,9 +34,10 @@ class Transaction:
               recipients: Sequence[bytes], anonymous: bool = True) -> None:
         issuer = self.party.wallets.issuer_wallet(issuer_wallet_id)
         anonymous = anonymous and self.party.driver.supports_anonymous_issue
-        self.party.tms.add_issue(
-            self.request, issuer, token_type, values, recipients, anonymous
-        )
+        with mx.span("ttx.assemble", tx=self.tx_id, kind="issue"):
+            self.party.tms.add_issue(
+                self.request, issuer, token_type, values, recipients, anonymous
+            )
         self.party.db.add_transaction(
             self.tx_id, TxType.ISSUE, issuer_wallet_id, "", token_type, sum(values)
         )
@@ -43,6 +45,11 @@ class Transaction:
     def transfer(self, owner_wallet_id: str, token_type: str,
                  values: Sequence[int], recipients: Sequence[bytes]) -> None:
         """Select inputs, build the transfer (+change), record movements."""
+        with mx.span("ttx.assemble", tx=self.tx_id, kind="transfer"):
+            self._transfer(owner_wallet_id, token_type, values, recipients)
+
+    def _transfer(self, owner_wallet_id: str, token_type: str,
+                  values: Sequence[int], recipients: Sequence[bytes]) -> None:
         amount = sum(values)
         selector = self.party.selectors.new_selector(self.tx_id)
         ids, total = selector.select(amount, token_type)
@@ -90,18 +97,23 @@ class Transaction:
         Reference ttx/collect.go + auditor.go: the request is audited
         BEFORE ordering; the auditor signature covers actions + metadata.
         """
-        self.party.tms.sign_transfers(self.request)
-        self.party.tms.sign_issues(self.request)
-        if auditor is not None:
-            auditor.audit(self.request)
+        with mx.span("ttx.endorse", tx=self.tx_id):
+            self.party.tms.sign_transfers(self.request)
+            self.party.tms.sign_issues(self.request)
+            if auditor is not None:
+                auditor.audit(self.request)
 
     # ------------------------------------------------------------ ordering
 
     def submit(self) -> FinalityEvent:
-        event = self.party.network.submit(self.request.to_bytes())
+        mx.counter("ttx.submitted").inc()
+        with mx.span("ttx.order_and_finality", tx=self.tx_id):
+            event = self.party.network.submit(self.request.to_bytes())
         if event.status != TxStatus.VALID:
+            mx.counter("ttx.rejected").inc()
             self.party.selectors.unlock_by_tx(self.tx_id)
             raise ValidationError(f"tx {self.tx_id} rejected: {event.message}")
+        mx.counter("ttx.committed").inc()
         return event
 
     def abort(self) -> None:

@@ -17,6 +17,7 @@ from ...api.driver import Driver
 from ...api.request import TokenRequest
 from ...models.quantity import Quantity
 from ...models.token import ID, UnspentToken
+from ...utils import metrics as mx
 from ..network.ledger import FinalityEvent, TxStatus
 
 
@@ -43,11 +44,12 @@ class Vault:
         if event.status != TxStatus.VALID:
             return
         tx_id = event.tx_id
-        with self._lock:
+        with mx.span("vault.on_finality", tx=tx_id), self._lock:
             # delete spent
             for rec in request.transfers:
                 for token_id in rec.input_ids:
-                    self._tokens.pop(token_id.key(), None)
+                    if self._tokens.pop(token_id.key(), None) is not None:
+                        mx.counter("vault.tokens.spent").inc()
             # store owned outputs; output indices are global across actions
             out_index = 0
             for rec in request.issues:
@@ -62,6 +64,7 @@ class Vault:
                 for raw, meta in zip(outputs, metas):
                     self._maybe_store(tx_id, out_index, raw, meta)
                     out_index += 1
+            mx.gauge("vault.tokens.held").set(len(self._tokens))
 
     def _action_outputs(self, action_bytes: bytes) -> List[bytes]:
         from ...crypto.serialization import loads
@@ -75,12 +78,14 @@ class Vault:
         token_id = ID(tx_id, index)
         try:
             decoded = self.driver.output_to_unspent(token_id, output, metadata)
+            mx.counter("vault.tokens.stored").inc()
         except Exception as e:
             # metadata missing/mismatched: keep raw bytes, flag loudly —
             # the token is unusable until re-delivered
             from ...utils.tracing import logger
 
             logger.warning("vault: cannot open owned token %s: %s", token_id, e)
+            mx.counter("vault.tokens.open_failures").inc()
             decoded = None
         self._tokens[token_id.key()] = StoredToken(token_id, output, metadata, decoded)
 

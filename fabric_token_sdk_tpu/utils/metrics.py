@@ -1,0 +1,581 @@
+"""Metrics core: counters, gauges, histograms, span trees, export plane.
+
+Reference parity: fabric-smart-client threads a metrics provider
+(`platform/view/services/metrics`) and `flogging` through every token
+service; this module is our equivalent, grown out of the original
+70-line `utils/tracing.py` span tracer.
+
+Design:
+
+* One process-wide thread-safe ``Registry`` (``REGISTRY``) holding named
+  counters / gauges / histograms, completed span trees, and phase
+  timelines. Instruments are get-or-create by name, so call sites never
+  coordinate.
+* **Counters are always live** — an increment is one lock + int add,
+  unmeasurable next to any group operation — while **spans and
+  heartbeats are env-gated** (``FTS_METRICS=1``, or ``enable()``):
+  the disabled ``span()`` fast path is a single global check.
+* Export: ``to_json()`` (the ``*.metrics.json`` sidecar format read by
+  ``cmd/ftsmetrics.py``) and ``to_prometheus()`` (text exposition
+  format, counters/gauges/histograms only).
+* Crash-proofing: ``install_sidecar(path)`` registers an ``atexit``
+  hook plus SIGTERM/SIGINT handlers that flush the registry to a JSON
+  sidecar, so a killed benchmark (rc=124) still leaves a full
+  accounting. ``flush_sidecar()`` can also be called explicitly (e.g.
+  from a watchdog thread about to ``os._exit``).
+* ``Heartbeat`` emits phase-stamped progress lines to stderr from a
+  daemon thread (``[fts] phase=compile elapsed=134s``) and records the
+  phase timeline in the registry.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+_enabled = os.environ.get("FTS_METRICS", "0").strip().lower() in _TRUTHY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(flag: bool = True) -> None:
+    """Turn span/heartbeat recording on (bench does this unconditionally)."""
+    global _enabled
+    _enabled = flag
+
+
+# ------------------------------------------------------------ instruments
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+# Latency buckets sized for this codebase: sub-ms host ops up through
+# multi-minute XLA pairing compiles.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+class Histogram:
+    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> dict:
+        # timed acquire: may run under a signal handler (see Registry)
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            d = {
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "buckets": {
+                    ("%g" % b): c
+                    for b, c in zip(self.buckets, self._counts)
+                    if c
+                },
+            }
+            if self._counts[-1]:
+                d["buckets"]["+Inf"] = self._counts[-1]
+            if self._count:
+                d["min"] = round(self._min, 6)
+                d["max"] = round(self._max, 6)
+                d["mean"] = round(self._sum / self._count, 6)
+            return d
+        finally:
+            if acquired:
+                self._lock.release()
+
+
+# ------------------------------------------------------------ span trees
+
+
+@dataclass
+class Span:
+    name: str
+    start: float  # monotonic
+    end: Optional[float] = None
+    attrs: dict = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) - self.start
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "duration_s": round(self.duration, 6)}
+        if self.attrs:
+            d["attrs"] = {k: _jsonable(v) for k, v in self.attrs.items()}
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Timed span; nests into the per-thread open span, auto-observes its
+    duration into histogram ``<name>.seconds``. No-op (yields None) when
+    metrics are disabled."""
+    if not _enabled:
+        yield None
+        return
+    s = Span(name, time.monotonic(), attrs=attrs)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    parent = stack[-1] if stack else None
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        s.end = time.monotonic()
+        stack.pop()
+        if parent is not None:
+            parent.children.append(s)
+        else:
+            REGISTRY.record_span_root(s)
+        REGISTRY.histogram(name + ".seconds").observe(s.duration)
+
+
+# ------------------------------------------------------------ registry
+
+
+class Registry:
+    """Thread-safe named-instrument store + export plane."""
+
+    MAX_SPAN_ROOTS = 2000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._span_roots: List[Span] = []
+        self._phases: List[dict] = []
+        self._meta: Dict[str, object] = {}
+
+    # -- get-or-create -------------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        """Get-or-create by name; `buckets` applies only on FIRST creation
+        — a later caller passing different buckets gets the existing
+        instrument unchanged."""
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name, buckets))
+        return h
+
+    # -- spans / phases / meta ----------------------------------------
+
+    def record_span_root(self, s: Span) -> None:
+        with self._lock:
+            self._span_roots.append(s)
+            if len(self._span_roots) > self.MAX_SPAN_ROOTS:
+                del self._span_roots[: self.MAX_SPAN_ROOTS // 2]
+
+    MAX_PHASES = 500
+
+    def record_phase(self, name: str, start: float, end: Optional[float],
+                     **attrs) -> None:
+        row = {"name": name, "start_unix": round(start, 3)}
+        if end is not None:
+            row["elapsed_s"] = round(end - start, 3)
+        if attrs:
+            row["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._phases.append(row)
+            if len(self._phases) > self.MAX_PHASES:
+                del self._phases[: self.MAX_PHASES // 2]
+
+    def set_meta(self, key: str, value) -> None:
+        # timed acquire: called from the SIGTERM handler, which may have
+        # interrupted the very thread holding this non-reentrant lock
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            self._meta[key] = _jsonable(value)
+        finally:
+            if acquired:
+                self._lock.release()
+
+    # -- export --------------------------------------------------------
+
+    def span_summary(self) -> Dict[str, dict]:
+        """Aggregate completed span trees by name (depth-first)."""
+        agg: Dict[str, dict] = {}
+
+        def walk(s: Span):
+            a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += s.duration
+            for c in s.children:
+                walk(c)
+
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            roots = list(self._span_roots)
+        finally:
+            if acquired:
+                self._lock.release()
+        for s in roots:
+            walk(s)
+        for a in agg.values():
+            a["total_s"] = round(a["total_s"], 6)
+        return agg
+
+    def snapshot(self) -> dict:
+        # timed acquire: flush_sidecar() runs from signal handlers, which
+        # can interrupt a thread that already holds this (non-reentrant)
+        # lock — fall back to a best-effort unlocked read over deadlock
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            counters = {n: c.value for n, c in sorted(self._counters.items())}
+            gauges = {n: g.value for n, g in sorted(self._gauges.items())}
+            hists = {n: h for n, h in sorted(self._histograms.items())}
+            phases = list(self._phases)
+            meta = dict(self._meta)
+            roots = list(self._span_roots)
+        finally:
+            if acquired:
+                self._lock.release()
+        return {
+            "meta": meta,
+            "flushed_unix": round(time.time(), 3),
+            "phases": phases,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {n: h.snapshot() for n, h in hists.items()},
+            "span_summary": self.span_summary(),
+            "spans": [s.to_dict() for s in roots[-200:]],
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=False)
+
+    def to_prometheus(self) -> str:
+        """Text exposition format. Metric names sanitized to [a-z0-9_]."""
+        lines: List[str] = []
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._histograms.items())
+        for name, c in counters:
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {c.value}")
+        for name, g in gauges:
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_prom_num(g.value)}")
+        for name, h in hists:
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            with h._lock:
+                counts = list(h._counts)
+                total, s = h._count, h._sum
+            for b, n in zip(h.buckets, counts):
+                cum += n
+                lines.append(f'{m}_bucket{{le="{_prom_num(b)}"}} {cum}')
+            cum += counts[-1]
+            lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{m}_sum {_prom_num(s)}")
+            lines.append(f"{m}_count {total}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._span_roots.clear()
+            self._phases.clear()
+            self._meta.clear()
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() else "_" for c in name.lower())
+    if out and out[0].isdigit():
+        out = "_" + out
+    return "fts_" + out
+
+
+def _prom_num(v: float) -> str:
+    return ("%d" % v) if float(v).is_integer() else repr(float(v))
+
+
+REGISTRY = Registry()
+
+
+# convenience module-level aliases used throughout the runtime
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, buckets)
+
+
+@contextlib.contextmanager
+def timed(hist_name: str):
+    """Observe the block's wall time into a histogram (gated like span)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        REGISTRY.histogram(hist_name).observe(time.monotonic() - t0)
+
+
+# ------------------------------------------------------------ heartbeat
+
+
+class Heartbeat:
+    """Phase-stamped progress lines on stderr from a daemon thread.
+
+    ``[fts] phase=compile program=miller_tile elapsed=134s total=250s``
+
+    Phases (and their wall times) are also recorded in the registry so a
+    sidecar flushed at death reports exactly where the time went.
+    """
+
+    def __init__(self, tag: str = "fts", interval_s: Optional[float] = None,
+                 stream=None):
+        self.tag = tag
+        self.interval_s = (
+            float(os.environ.get("FTS_HEARTBEAT_SECS", "15"))
+            if interval_s is None
+            else interval_s
+        )
+        self.stream = stream if stream is not None else sys.stderr
+        self._t0 = time.time()
+        self._phase = "init"
+        self._phase_start = self._t0
+        self._attrs: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_phase(self, name: str, **attrs) -> None:
+        now = time.time()
+        with self._lock:
+            prev, prev_start, prev_attrs = self._phase, self._phase_start, self._attrs
+            self._phase, self._phase_start, self._attrs = name, now, attrs
+        if _enabled:  # phases are gated like spans/heartbeat lines
+            REGISTRY.record_phase(prev, prev_start, now, **prev_attrs)
+            REGISTRY.gauge("progress.phase_start_unix").set(now)
+            REGISTRY.set_meta("progress.phase", name)
+        self.emit()
+
+    def emit(self) -> None:
+        if not _enabled:
+            return  # heartbeats are env-gated like spans (FTS_METRICS=1)
+        with self._lock:
+            phase, phase_start, attrs = self._phase, self._phase_start, self._attrs
+        now = time.time()
+        extra = "".join(f" {k}={_jsonable(v)}" for k, v in attrs.items())
+        try:
+            print(
+                f"[{self.tag}] phase={phase}{extra} "
+                f"elapsed={now - phase_start:.0f}s total={now - self._t0:.0f}s",
+                file=self.stream,
+                flush=True,
+            )
+        except Exception:
+            pass  # stderr may be gone at interpreter teardown
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="fts-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.emit()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            phase, phase_start, attrs = self._phase, self._phase_start, self._attrs
+        if _enabled:
+            REGISTRY.record_phase(phase, phase_start, time.time(), **attrs)
+
+
+# ------------------------------------------------------------ sidecar
+
+
+_sidecar_lock = threading.Lock()
+_sidecar_path: Optional[str] = None
+_sidecar_installed = False
+
+
+def flush_sidecar(path: Optional[str] = None) -> Optional[str]:
+    """Write the registry snapshot to the sidecar JSON (atomic rename).
+
+    Safe to call from signal handlers and watchdog threads; returns the
+    path written, or None if no path is configured.
+    """
+    p = path or _sidecar_path
+    if not p:
+        return None
+    payload = REGISTRY.to_json()
+    acquired = _sidecar_lock.acquire(timeout=2.0)  # may run under a signal
+    try:
+        tmp = f"{p}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(payload)
+            os.replace(tmp, p)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    finally:
+        if acquired:
+            _sidecar_lock.release()
+    return p
+
+
+def install_sidecar(path: str,
+                    signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT)) -> None:
+    """Flush a metrics sidecar on normal exit AND on SIGTERM/SIGINT.
+
+    This is what turns an rc=124 (``timeout`` sends SIGTERM) from a
+    zero-information outcome into a full per-phase accounting. Signal
+    handlers chain to the default disposition so the exit code still
+    reflects the kill.
+    """
+    global _sidecar_path, _sidecar_installed
+    _sidecar_path = path
+    if _sidecar_installed:
+        return
+    _sidecar_installed = True
+    atexit.register(flush_sidecar)
+
+    def _on_signal(signum, frame):
+        REGISTRY.set_meta("killed_by_signal", signum)
+        flush_sidecar()
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    for sig in signals:
+        try:
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread / unsupported platform

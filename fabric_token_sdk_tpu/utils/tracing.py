@@ -1,70 +1,47 @@
-"""Lightweight span tracing + counters (reference: fabric-smart-client's
-flogging/metrics used throughout token/services)."""
+"""Span tracing facade over the metrics core (``utils/metrics.py``).
+
+Historical note: this module began as a standalone 70-line tracer wired
+into exactly one call site; it is now a thin compatibility adapter so
+existing ``tracer.span(...)`` / ``tracer.count(...)`` call sites feed the
+process-wide metrics registry (one export plane, one enable switch —
+``FTS_METRICS=1``). New code should import ``utils.metrics`` directly.
+"""
 
 from __future__ import annotations
 
-import contextlib
 import logging
-import threading
-import time
-from collections import defaultdict
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict
+
+from . import metrics
 
 logger = logging.getLogger("fts_tpu")
 
-
-@dataclass
-class Span:
-    name: str
-    start: float
-    end: Optional[float] = None
-    attrs: dict = field(default_factory=dict)
-
-    @property
-    def duration(self) -> float:
-        return (self.end or time.monotonic()) - self.start
+# re-exported for callers that used the old dataclass directly
+Span = metrics.Span
 
 
 class Tracer:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.spans: List[Span] = []
-        self.counters: Dict[str, int] = defaultdict(int)
-        self.enabled = True
+    """Compatibility shim: the old Tracer API over the shared registry."""
 
-    @contextlib.contextmanager
+    @property
+    def enabled(self) -> bool:
+        return metrics.enabled()
+
+    @enabled.setter
+    def enabled(self, flag: bool) -> None:
+        metrics.enable(flag)
+
     def span(self, name: str, **attrs):
-        if not self.enabled:
-            yield None
-            return
-        s = Span(name, time.monotonic(), attrs=attrs)
-        try:
-            yield s
-        finally:
-            s.end = time.monotonic()
-            with self._lock:
-                self.spans.append(s)
-                if len(self.spans) > 10000:
-                    del self.spans[:5000]
+        return metrics.span(name, **attrs)
 
     def count(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counters[name] += n
+        metrics.counter(name).inc(n)
 
     def summary(self) -> Dict[str, dict]:
-        with self._lock:
-            agg: Dict[str, dict] = {}
-            for s in self.spans:
-                a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0})
-                a["count"] += 1
-                a["total_s"] += s.duration
-            return agg
+        return metrics.REGISTRY.span_summary()
 
     def reset(self) -> None:
-        with self._lock:
-            self.spans.clear()
-            self.counters.clear()
+        metrics.REGISTRY.reset()
 
 
 tracer = Tracer()
