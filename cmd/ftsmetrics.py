@@ -93,6 +93,22 @@ def show(path: str, prometheus: bool = False) -> None:
         print(f"  {'TOTAL':<18} {_fmt_s(total):>10}")
 
     _print_kv("counters", sorted(d.get("counters", {}).items()))
+
+    # one-line compile/cache health: the cold-cache-regression check.
+    # programs = distinct XLA programs backend-compiled this run; a warm
+    # persistent cache shows programs=0 with cache_hits > 0.
+    comp = d.get("histograms", {}).get(
+        "jax.core.compile.backend_compile_duration.seconds", {}
+    )
+    ctr = d.get("counters", {})
+    print(
+        f"\ncompile summary: programs={comp.get('count', 0)}"
+        f" compile_sum={_fmt_s(comp.get('sum', 0.0))}"
+        f" cache_hits={ctr.get('jax.compilation_cache.cache_hits', 0)}"
+        f" cache_misses={ctr.get('jax.compilation_cache.cache_misses', 0)}"
+        f" load_failures={ctr.get('jax.cache.load_failures', 0)}"
+    )
+
     _print_kv(
         "gauges",
         sorted(d.get("gauges", {}).items()),

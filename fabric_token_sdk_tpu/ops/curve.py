@@ -172,12 +172,23 @@ def encode_points(pts) -> jnp.ndarray:
     return jnp.asarray(np.stack([encode_point(p) for p in pts]))
 
 
+_RINV = None  # lazily: R^-1 mod p for host Montgomery decode
+
+
 def decode_points(arr):
-    """Device (..., 3, NLIMBS) -> host affine tuples (inversion on host)."""
-    flat = np.asarray(FP.from_mont(arr)).reshape(-1, 3, lb.NLIMBS)
+    """Device (..., 3, NLIMBS) -> host affine tuples.
+
+    Pure host arithmetic — Montgomery conversion is one modular multiply
+    by R^-1 per coordinate, inversion via Fermat on python ints — so
+    decoding compiles no device program (the batched verifiers' XLA
+    program set stays independent of batch/statement shape)."""
+    global _RINV
+    if _RINV is None:
+        _RINV = pow(1 << (lb.RADIX_BITS * lb.NLIMBS), -1, hm.P)
+    flat = np.asarray(arr).reshape(-1, 3, lb.NLIMBS)
     out = []
     for row in flat:
-        x, y, z = (lb.limbs_to_int(c) for c in row)
+        x, y, z = (lb.limbs_to_int(c) * _RINV % hm.P for c in row)
         if z == 0:
             out.append(None)
             continue
@@ -242,12 +253,11 @@ class FixedBaseTable:
         return msm_flat(self.flat, scalars)
 
 
-@jax.jit
-def msm_flat(flat, scalars):
-    """Fixed-base windowed multiexp against a table passed as an ARGUMENT
-    (not a baked constant), so the compiled program is shared across all
-    parameter sets — callers with different Pedersen bases / public keys
-    reuse one XLA executable per shape."""
+def msm_select(flat, scalars):
+    """Window-digit point selection shared by every msm reduction:
+    scalars (..., nbases, NLIMBS) x table (nbases*64, 16, 3L) ->
+    selected window points (..., nbases*64, 3, NLIMBS). The one-hot
+    digit contraction is a dense matmul that rides the MXU."""
     nbases = flat.shape[0] // DIGITS_PER_SCALAR
     shifts = jnp.arange(0, lb.RADIX_BITS, WINDOW_BITS, dtype=jnp.int32)
     digs = (scalars[..., :, :, None] >> shifts) & ((1 << WINDOW_BITS) - 1)
@@ -257,8 +267,16 @@ def msm_flat(flat, scalars):
         jnp.int32
     )  # (..., nbases*64, 16)
     sel = jnp.einsum("...td,tdc->...tc", onehot, flat)
-    sel = sel.reshape(sel.shape[:-1] + (3, lb.NLIMBS))
-    return tree_sum(sel, axis=-3)
+    return sel.reshape(sel.shape[:-1] + (3, lb.NLIMBS))
+
+
+@jax.jit
+def msm_flat(flat, scalars):
+    """Fixed-base windowed multiexp against a table passed as an ARGUMENT
+    (not a baked constant), so the compiled program is shared across all
+    parameter sets — callers with different Pedersen bases / public keys
+    reuse one XLA executable per shape."""
+    return tree_sum(msm_select(flat, scalars), axis=-3)
 
 
 @functools.lru_cache(maxsize=8)

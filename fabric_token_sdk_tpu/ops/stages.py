@@ -1,0 +1,231 @@
+"""Primitive stage kernels: the compile-once tiles of the verify plane.
+
+The previous data plane fused each verifier's whole group-math pipeline
+into one giant per-shape XLA program (`_wf_kernel` & co in
+`crypto/batch.py`): a new transfer shape `(n_in, n_out)` meant a new
+multi-minute compile, and the FIRST compile alone could blow the tier-1
+budget. This module generalizes the staged execution model proven by
+`pairing.pairing_product_staged`: a small, fixed set of **primitive stage
+kernels**, each `jax.jit`'d once at a single canonical tile shape, with
+all inter-stage glue (reshape / broadcast / concat / challenge repeat) in
+host numpy. Verifiers become host-side compositions of these stages, so
+the total distinct-program count is a small constant — independent of
+batch size, transfer shape, and parameter set.
+
+Stage inventory (ROW_TILE flat rows each; tables/keys are ARGUMENTS, not
+baked constants, so one executable serves every parameter set):
+
+  G1:  msm tile (per nbases in {1,2,3}), variable-base scalar-mul tile,
+       Jacobian add tile, Jacobian sub tile (add + neg fused),
+       batch to-affine tile
+  G2:  variable-base scalar-mul tile, Jacobian add tile,
+       batch to-affine tile
+
+Program-size discipline: one inlined Jacobian point-op costs ~40s of XLA
+CPU compile on a small host, so every stage keeps at most ~2 point-ops in
+its traced body. In particular the msm point reduction is a `lax.scan`
+with a SINGLE add per step instead of a fully unrolled log-depth tree
+(~191 inlined adds for a 3-base table) — the same total point additions
+at runtime, but a ~100x smaller program.
+
+`stage_programs()` enumerates every (name, jitted fn, canonical arg
+shapes) triple so `ops/warmup.py` can AOT-compile the whole set into the
+persistent cache ahead of time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import curve as cv, curve2 as cv2, limbs as lb
+from .field import FP
+from ..utils import metrics as mx
+
+# Canonical tile height: every stage kernel sees exactly ROW_TILE flat
+# rows (batches are flattened over (B, n) and padded by repeating row 0;
+# padded outputs are discarded).
+ROW_TILE = 8
+
+# ------------------------------------------------------------ tile kernels
+
+@jax.jit
+def _g1_msm_tile(table_flat, scalars):
+    """Fixed-base windowed multiexp tile.
+
+    table_flat: (nbases*64, 16, 3L) window table (argument, shared across
+    parameter sets); scalars: (R, nbases, L) canonical limbs.
+    Returns (R, 3, L) Jacobian. One program per nbases (3 total, ever).
+
+    Digit selection is `cv.msm_select` (shared with `cv.msm_flat`); the
+    point reduction is a scan with ONE add per step to keep the program
+    small (see module docstring).
+    """
+    sel = cv.msm_select(table_flat, scalars)  # (R, T, 3, L)
+    pts = jnp.moveaxis(sel, -3, 0)  # (T, R, 3, L)
+
+    def step(acc, p):
+        return cv.add(acc, p), None
+
+    acc, _ = lax.scan(step, cv.infinity(pts.shape[1:-2]), pts)
+    return acc
+
+
+@jax.jit
+def _g1_sub_tile(a, b):
+    """a - b on (R, 3, L) Jacobian tiles (the commitment-minus-statement
+    step of every sigma verification)."""
+    return cv.add(a, cv.neg(b))
+
+
+@jax.jit
+def _g1_to_affine_tile(p):
+    """(R, 3, L) Jacobian -> (R, 2, L) affine (Fermat inversion on
+    device). Infinity lanes come back (0, 0) — the caller masks."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    zi = FP.inv(z)
+    zi2 = FP.mul(zi, zi)
+    return jnp.stack([FP.mul(x, zi2), FP.mul(FP.mul(y, zi2), zi)], axis=-2)
+
+
+_g2_to_affine_tile = jax.jit(cv2.to_affine_device)
+
+
+# ------------------------------------------------------------ tile runner
+
+def run_rows(kernel, *arrays, consts=()):
+    """Run `kernel(*consts, *tiles)` over ROW_TILE slabs of flat-row
+    numpy arrays -> numpy. The staged successor of the old
+    `crypto.batch._run_tiled`.
+
+    * `arrays` share a leading flat row axis N; rows are padded to a
+      ROW_TILE multiple by repeating row 0 (padded outputs discarded).
+    * `consts` are parameter tensors (window tables, public keys) passed
+      whole to every tile call — arguments, not baked jit constants.
+    * Tiles are CONTIGUOUS numpy views of a single padded buffer (one
+      host-side copy at most, only when padding is needed); the only
+      host->device transfers are the per-tile `jnp.asarray` calls,
+      counted in `batch.tiled.transfers`.
+    """
+    N = arrays[0].shape[0]
+    if N == 0:
+        raise ValueError("run_rows: empty row batch (caller must guard)")
+    pad = (-N) % ROW_TILE
+    if pad:
+        padded = []
+        for a in arrays:
+            buf = np.empty((N + pad,) + a.shape[1:], dtype=a.dtype)
+            buf[:N] = a
+            buf[N:] = a[:1]
+            padded.append(buf)
+        arrays = tuple(padded)
+    else:
+        arrays = tuple(np.ascontiguousarray(a) for a in arrays)
+    ntiles = (N + pad) // ROW_TILE
+    mx.counter("stages.calls").inc()
+    mx.counter("stages.rows").inc(N)
+    mx.counter("stages.tiles").inc(ntiles)
+    mx.counter("batch.tiled.transfers").inc(ntiles * len(arrays))
+    outs = [
+        kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
+        for t in range(0, N + pad, ROW_TILE)
+    ]
+    if isinstance(outs[0], (tuple, list)):
+        return tuple(
+            np.concatenate([np.asarray(o[i]) for o in outs])[:N]
+            for i in range(len(outs[0]))
+        )
+    return np.concatenate([np.asarray(o) for o in outs])[:N]
+
+
+# ------------------------------------------------------------ compositions
+#
+# Thin named wrappers so verifier code reads as algebra. Every wrapper
+# takes/returns HOST numpy (flat rows); `consts` device residency is the
+# caller's choice (jnp tables stay resident, numpy is transferred).
+
+def g1_msm_rows(table_flat, scalars: np.ndarray) -> np.ndarray:
+    """(N, nbases, L) canonical scalars x fixed-base table -> (N, 3, L)."""
+    return run_rows(_g1_msm_tile, scalars, consts=(table_flat,))
+
+
+def g1_mul_rows(points: np.ndarray, scalars: np.ndarray) -> np.ndarray:
+    """Variable-base scalar mul: (N, 3, L) x (N, L) -> (N, 3, L)."""
+    return run_rows(cv.scalar_mul, points, scalars)
+
+
+def g1_add_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return run_rows(cv.add, a, b)
+
+
+def g1_sub_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return run_rows(_g1_sub_tile, a, b)
+
+
+def g1_to_affine_rows(p: np.ndarray) -> np.ndarray:
+    return run_rows(_g1_to_affine_tile, p)
+
+
+def g2_mul_rows(points: np.ndarray, scalars: np.ndarray) -> np.ndarray:
+    """(N, 3, 2, L) x (N, L) -> (N, 3, 2, L)."""
+    return run_rows(cv2.scalar_mul, points, scalars)
+
+
+def g2_add_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return run_rows(cv2.add, a, b)
+
+
+def g2_to_affine_rows(p: np.ndarray) -> np.ndarray:
+    return run_rows(_g2_to_affine_tile, p)
+
+
+def g2_tree_sum_rows(terms: np.ndarray) -> np.ndarray:
+    """Per-row sum of k G2 terms: (N, k, 3, 2, L) -> (N, 3, 2, L).
+
+    Host-side log-depth fold — each level is ONE tiled add over the
+    flattened pair rows, so no per-k device program exists.
+    """
+    while terms.shape[1] > 1:
+        k = terms.shape[1]
+        half = k // 2
+        rest = terms[:, 2 * half :]
+        flat_a = terms[:, :half].reshape((-1,) + terms.shape[2:])
+        flat_b = terms[:, half : 2 * half].reshape((-1,) + terms.shape[2:])
+        summed = g2_add_rows(flat_a, flat_b).reshape(
+            (terms.shape[0], half) + terms.shape[2:]
+        )
+        terms = np.concatenate([summed, rest], axis=1) if rest.shape[1] else summed
+    return terms[:, 0]
+
+
+def affine_to_jac_np(p: np.ndarray) -> np.ndarray:
+    """Host glue: (..., 2, L) Montgomery affine -> (..., 3, L) Jacobian
+    with Z = 1 (pure numpy — no device program)."""
+    one = np.broadcast_to(
+        np.asarray(FP.one_mont, dtype=np.int32), p[..., 0, :].shape
+    )
+    return np.concatenate([p, one[..., None, :]], axis=-2)
+
+
+# ------------------------------------------------------------ warmup hooks
+
+def stage_programs():
+    """Yield (name, jitted_fn, canonical arg shapes) for every stage
+    program, for AOT precompilation (`ops/warmup.py`). int32 throughout."""
+    R, L = ROW_TILE, lb.NLIMBS
+    W = 1 << cv.WINDOW_BITS
+    for nbases in (1, 2, 3):
+        yield (
+            f"g1_msm{nbases}_tile",
+            _g1_msm_tile,
+            ((nbases * cv.DIGITS_PER_SCALAR, W, 3 * L), (R, nbases, L)),
+        )
+    yield ("g1_mul_tile", cv.scalar_mul, ((R, 3, L), (R, L)))
+    yield ("g1_add_tile", cv.add, ((R, 3, L), (R, 3, L)))
+    yield ("g1_sub_tile", _g1_sub_tile, ((R, 3, L), (R, 3, L)))
+    yield ("g1_to_affine_tile", _g1_to_affine_tile, ((R, 3, L),))
+    yield ("g2_mul_tile", cv2.scalar_mul, ((R, 3, 2, L), (R, L)))
+    yield ("g2_add_tile", cv2.add, ((R, 3, 2, L), (R, 3, 2, L)))
+    yield ("g2_to_affine_tile", _g2_to_affine_tile, ((R, 3, 2, L),))

@@ -144,9 +144,17 @@ def encode_fp2(vals) -> np.ndarray:
     return out
 
 
+_RINV = pow(1 << (lb.RADIX_BITS * lb.NLIMBS), -1, hm.P)
+
+
 def decode_fp2(arr):
-    a = np.asarray(arr)
-    flat = FP.decode(jnp.asarray(a.reshape(-1, lb.NLIMBS)))
+    """Montgomery limb tensor -> host fp2 int tuples.
+
+    Pure host arithmetic (limb recomposition + one modular multiply by
+    R^-1): decoding compiles no device program, so batched verifiers stay
+    shape-invariant in their XLA program set."""
+    a = np.asarray(arr).reshape(-1, lb.NLIMBS)
+    flat = [lb.limbs_to_int(row) * _RINV % hm.P for row in a]
     return [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
 
 
@@ -265,10 +273,15 @@ def fp12_inv(x):
     return _join(R[0], _fp6_neg(R[1]))
 
 
-def _fp12_one_np() -> np.ndarray:
+def fp12_one_np() -> np.ndarray:
+    """The GT/Fp12 identity as a HOST numpy constant (Montgomery limbs) —
+    for numpy glue paths that must not touch the device."""
     out = np.zeros((6, 2, lb.NLIMBS), dtype=np.int32)
     out[0, 0] = np.asarray(FP.one_mont)
     return out
+
+
+_fp12_one_np = fp12_one_np  # internal alias (fp12_ones below)
 
 
 def fp12_ones(shape=()):

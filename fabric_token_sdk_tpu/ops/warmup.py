@@ -1,0 +1,115 @@
+"""Warmup precompiler: AOT-compile every canonical verify-plane program.
+
+The staged execution model (`ops/stages.py`, `ops/pairing.py` tiles)
+makes the verifier's distinct-program set a small constant; this module
+compiles that whole set ahead of time — populating the persistent XLA
+compilation cache (`FTS_TPU_JAX_CACHE`, default `~/.cache/fts_tpu_jax`) —
+so no verify, test, or benchmark ever pays a surprise giant compile
+mid-flight. After `warmup()` (or `python cmd/ftswarmup.py`), a
+`BatchedTransferVerifier.verify` recompiles nothing: every program loads
+as a `jax.compilation_cache.cache_hits` hit (`cache_misses` stays 0).
+
+Entry points:
+  * `warmup()`               — library call (bench.py, pytest fixture)
+  * `cmd/ftswarmup.py`       — CLI wrapper
+  * `FTS_WARMUP=1 pytest`    — opt-in session fixture (tests/conftest.py)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import limbs as lb, pairing as pr, stages as st
+from ..utils import metrics as mx
+
+_CACHE_COUNTERS = (
+    "jax.compilation_cache.cache_hits",
+    "jax.compilation_cache.cache_misses",
+)
+_COMPILES = "jax.core.compile.backend_compile_duration.seconds"
+
+
+def pairing_programs() -> Iterable[Tuple[str, object, tuple]]:
+    """The staged pairing tile programs (miller / per-K product /
+    final-exp), canonical shapes. K covers every verifier pairing product:
+    2 legs (Pointcheval-Sanders) and 4 legs (membership)."""
+    L = lb.NLIMBS
+    yield (
+        "miller_tile",
+        pr.miller_loop,
+        ((pr.MILLER_TILE, 2, L), (pr.MILLER_TILE, 2, 2, L)),
+    )
+    for k in (2, 4):
+        yield (f"gt_product_k{k}_tile", pr._product_rows, ((pr.FEXP_TILE, k, 6, 2, L),))
+    yield ("final_exp_tile", pr.final_exp, ((pr.FEXP_TILE, 6, 2, L),))
+
+
+def all_programs(include_pairing: bool = True):
+    progs = list(st.stage_programs())
+    if include_pairing:
+        progs += list(pairing_programs())
+    return progs
+
+
+def warmup(
+    include_pairing: bool = True,
+    persist_all: bool = True,
+    progress: Optional[callable] = None,
+) -> dict:
+    """AOT-lower and compile every canonical program; returns a summary.
+
+    persist_all drops `jax_persistent_cache_min_compile_time_secs` to 0 so
+    even fast-compiling tile programs land in the persistent cache — the
+    guarantee that a LATER process replays the whole verify plane from
+    cache hits alone (cache_misses stays 0; nothing recompiles).
+    """
+    prev_min_compile = None
+    if persist_all:
+        try:
+            prev_min_compile = jax.config.jax_persistent_cache_min_compile_time_secs
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:  # older jax without the knob
+            pass
+
+    before = {c: mx.REGISTRY.counter(c).value for c in _CACHE_COUNTERS}
+    compiles_before = mx.REGISTRY.histogram(_COMPILES).count
+    programs = []
+    t_total = time.time()
+    try:
+        with mx.span("warmup.precompile", include_pairing=include_pairing):
+            for name, fn, shapes in all_programs(include_pairing):
+                specs = [jax.ShapeDtypeStruct(s, jnp.int32) for s in shapes]
+                t0 = time.time()
+                fn.lower(*specs).compile()
+                dt = time.time() - t0
+                mx.counter("warmup.programs").inc()
+                mx.REGISTRY.histogram("warmup.program.seconds").observe(dt)
+                programs.append({"name": name, "seconds": round(dt, 3)})
+                if progress is not None:
+                    progress(name, dt)
+    finally:
+        # confine persist-everything to the warmup set: later incidental
+        # compiles go back to the configured persistence threshold
+        if prev_min_compile is not None:
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    prev_min_compile,
+                )
+            except Exception:
+                pass
+    total = time.time() - t_total
+    summary = {
+        "programs": len(programs),
+        "seconds": round(total, 3),
+        "backend_compiles": mx.REGISTRY.histogram(_COMPILES).count - compiles_before,
+        "per_program": programs,
+    }
+    for c in _CACHE_COUNTERS:
+        summary[c.rsplit(".", 1)[-1]] = mx.REGISTRY.counter(c).value - before[c]
+    mx.gauge("warmup.seconds").set(round(total, 3))
+    return summary

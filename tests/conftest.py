@@ -46,6 +46,21 @@ import random
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def fts_warmup_session():
+    """Opt-in session warmup: `FTS_WARMUP=1 pytest ...` AOT-compiles the
+    whole canonical stage/pairing program set up front (populating the
+    persistent cache), so no test ever pays a surprise giant compile
+    mid-session. `FTS_WARMUP_PAIRING=0` skips the large pairing tiles."""
+    if os.environ.get("FTS_WARMUP") == "1":
+        from fabric_token_sdk_tpu.ops import warmup as wu
+
+        wu.warmup(
+            include_pairing=os.environ.get("FTS_WARMUP_PAIRING", "1") == "1"
+        )
+    yield
+
+
 @pytest.fixture
 def rng():
     return random.Random(0xF75)

@@ -1,0 +1,143 @@
+"""Differential tests: stage tile kernels vs pure-host group math.
+
+Every primitive stage in `ops/stages.py` is pinned against
+`crypto/hostmath.py` on random inputs, including padding edges (batch
+sizes that are not ROW_TILE multiples) and the host-glue helpers.
+"""
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+from fabric_token_sdk_tpu.ops import curve as cv, curve2 as cv2, limbs as lb, \
+    stages as st, tower as tw
+from fabric_token_sdk_tpu.ops import pairing as pr
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+_RINV = pow(1 << (lb.RADIX_BITS * lb.NLIMBS), -1, hm.P)
+
+
+def _decode_affine_g1(aff):
+    """(N, 2, L) Montgomery affine limbs -> host (x, y) tuples."""
+    return [
+        (lb.limbs_to_int(row[0]) * _RINV % hm.P,
+         lb.limbs_to_int(row[1]) * _RINV % hm.P)
+        for row in aff
+    ]
+
+
+def _g1_jac(pts):
+    return np.stack([cv.encode_point(p) for p in pts])
+
+
+def _scalars(rng, n):
+    return [rng.randrange(hm.R) for _ in range(n)]
+
+
+def test_g1_mul_rows_matches_host(rng):
+    pts = [hm.g1_mul(hm.G1_GEN, 3 + i) for i in range(5)]  # odd: pads to 8
+    ks = _scalars(rng, 5)
+    got = st.g1_mul_rows(_g1_jac(pts), cv.encode_scalars(ks))
+    assert cv.decode_points(got) == [hm.g1_mul(p, k) for p, k in zip(pts, ks)]
+
+
+def test_g1_add_sub_rows_match_host(rng):
+    ps = [hm.g1_mul(hm.G1_GEN, 3 + i) for i in range(9)]
+    qs = [hm.g1_mul(hm.G1_GEN, 100 + i) for i in range(9)]
+    got = st.g1_add_rows(_g1_jac(ps), _g1_jac(qs))
+    assert cv.decode_points(got) == [hm.g1_add(p, q) for p, q in zip(ps, qs)]
+    got = st.g1_sub_rows(_g1_jac(ps), _g1_jac(qs))
+    assert cv.decode_points(got) == [
+        hm.g1_add(p, hm.g1_neg(q)) for p, q in zip(ps, qs)
+    ]
+    # edge rows: P - P = infinity, P + (-P) handled by the select logic
+    got = st.g1_sub_rows(_g1_jac(ps[:2]), _g1_jac(ps[:2]))
+    assert cv.decode_points(got) == [None, None]
+
+
+def test_g1_msm_rows_matches_host_multiexp(rng):
+    bases = [hm.g1_mul(hm.G1_GEN, 11 + i) for i in range(3)]
+    table = cv.FixedBaseTable(bases)
+    rows = [_scalars(rng, 3) for _ in range(6)]
+    got = st.g1_msm_rows(table.flat, np.stack([cv.encode_scalars(r) for r in rows]))
+    assert cv.decode_points(got) == [hm.g1_multiexp(bases, r) for r in rows]
+
+
+def test_g1_to_affine_rows_matches_decode(rng):
+    pts = [hm.g1_mul(hm.G1_GEN, 5 + i) for i in range(3)]
+    ks = cv.encode_scalars(_scalars(rng, 3))
+    jac = st.g1_mul_rows(_g1_jac(pts), ks)  # non-trivial Z coordinates
+    aff = st.g1_to_affine_rows(jac)
+    # affine limbs must decode to the same canonical points
+    assert _decode_affine_g1(aff) == cv.decode_points(jac)
+
+
+def test_affine_to_jac_np_round_trips():
+    pts = [hm.g1_mul(hm.G1_GEN, 7 + i) for i in range(4)]
+    aff = np.asarray(pr.encode_g1(pts))
+    jac = st.affine_to_jac_np(aff)
+    assert jac.shape == (4, 3, aff.shape[-1])
+    assert cv.decode_points(jac) == pts
+
+
+def test_run_rows_empty_batch_raises():
+    with pytest.raises(ValueError):
+        st.run_rows(cv.add, np.zeros((0, 3, 32), np.int32),
+                    np.zeros((0, 3, 32), np.int32))
+
+
+def test_run_rows_counts_transfers(rng):
+    before = mx.REGISTRY.counter("batch.tiled.transfers").value
+    ps = _g1_jac([hm.g1_mul(hm.G1_GEN, 2 + i) for i in range(9)])
+    st.g1_add_rows(ps, ps)  # 9 rows -> 2 tiles x 2 arrays = 4 transfers
+    assert mx.REGISTRY.counter("batch.tiled.transfers").value - before == 4
+
+
+def test_gt_is_one_host():
+    one = tw.fp12_one_np()
+    not_one = tw.encode_fp12([((2, 0), (0, 0), (0, 0), (0, 0), (0, 0), (0, 0))])[0]
+    got = pr.gt_is_one_host(np.stack([one, not_one]))
+    assert got.tolist() == [True, False]
+    assert pr.gt_is_one_host(np.zeros((0, 6, 2, 32), np.int32)).tolist() == []
+
+
+@pytest.mark.slow
+def test_g1_msm_rows_one_and_two_bases(rng):
+    for nb in (1, 2):
+        bases = [hm.g1_mul(hm.G1_GEN, 17 + i) for i in range(nb)]
+        table = cv.FixedBaseTable(bases)
+        rows = [_scalars(rng, nb) for _ in range(3)]
+        got = st.g1_msm_rows(
+            table.flat, np.stack([cv.encode_scalars(r) for r in rows])
+        )
+        assert cv.decode_points(got) == [hm.g1_multiexp(bases, r) for r in rows]
+
+
+@pytest.mark.slow
+def test_g2_stage_rows_match_host(rng):
+    pts = [hm.g2_mul(hm.G2_GEN, 3 + i) for i in range(5)]
+    ks = _scalars(rng, 5)
+    jac = np.asarray(cv2.encode_points(pts))
+    got = st.g2_mul_rows(jac, cv.encode_scalars(ks))
+    assert cv2.decode_points(got) == [hm.g2_mul(p, k) for p, k in zip(pts, ks)]
+
+    qs = [hm.g2_mul(hm.G2_GEN, 50 + i) for i in range(5)]
+    got = st.g2_add_rows(jac, np.asarray(cv2.encode_points(qs)))
+    assert cv2.decode_points(got) == [hm.g2_add(p, q) for p, q in zip(pts, qs)]
+
+    # tree sum over k=3 terms per row
+    terms = np.stack(
+        [np.asarray(cv2.encode_points([p, q, hm.G2_GEN]))
+         for p, q in zip(pts, qs)]
+    )
+    got = st.g2_tree_sum_rows(terms)
+    assert cv2.decode_points(got) == [
+        hm.g2_add(hm.g2_add(p, q), hm.G2_GEN) for p, q in zip(pts, qs)
+    ]
+
+    aff = st.g2_to_affine_rows(jac)
+    assert aff.shape == (5, 2, 2, jac.shape[-1])
+    # affine coordinates decode to the same host points
+    coords = tw.decode_fp2(aff.reshape(-1, 2, jac.shape[-1]))
+    decoded = [(coords[2 * i], coords[2 * i + 1]) for i in range(5)]
+    assert decoded == pts
