@@ -1,8 +1,14 @@
 """Headline benchmark: zkatdlog transfer-proof verification throughput.
 
-Prints ONE JSON line:
+Prints the result as a JSON line:
   {"metric": "zkatdlog_transfer_verify_throughput", "value": N,
    "unit": "tx/s", "vs_baseline": N / 133.0, ...}
+
+The headline line is printed as soon as the measured runs finish; if the
+optional `block_throughput` phase (product-path blocks through the
+orderer) completes, one more ENRICHED line — a strict superset of the
+same fields plus `block_*` — is printed, so first-line parsers get the
+headline and last-line parsers get the superset either way.
 
 Baseline (BASELINE.md): reference Go implementation, 2-in/2-out transfers
 with base=16 exponent=2 range proofs ~= 133 tx/s per x86 core.
@@ -33,6 +39,11 @@ import time
 # Persistent XLA compilation cache is configured centrally in
 # fabric_token_sdk_tpu/ops/__init__.py (~/.cache/fts_tpu_jax).
 
+# BASELINE.md: reference Go implementation, ~133 tx/s per x86 core for
+# the headline 2-in/2-out transfer-verify shape — the one denominator
+# every vs_baseline field in the result JSON uses
+GO_BASELINE_TX_S = 133.0
+
 # set once the result JSON has been printed; the deadline watchdog checks
 # it so a completed (or merely slow-but-healthy) run is never clobbered
 # by the CPU fallback re-exec
@@ -59,13 +70,20 @@ def _deadline_sidecar_path() -> str:
     return p + ".deadline.json"
 
 
-def _reexec_cpu() -> None:
-    """Restart this process pinned to local CPU (axon tunnel unhealthy)."""
+def _reexec_cpu(child_deadline: float = None) -> None:
+    """Restart this process pinned to local CPU (axon tunnel unhealthy).
+
+    `child_deadline`: budget hint for the child's watchdog — the
+    deadline-fired path passes a short one (its parent burned most of
+    the driver window); the early probe-failure path passes none (the
+    child inherits nearly the whole window)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # the fallback child must complete at all costs — do not let it
     # inherit the deadline that just killed the accelerator attempt
     env.pop("FTS_BENCH_DEADLINE", None)
+    if child_deadline is not None:
+        env.setdefault("FTS_BENCH_CHILD_DEADLINE", str(child_deadline))
     env["JAX_PLATFORMS"] = "cpu"
     env["_FTS_BENCH_REEXEC"] = "1"
     env["PYTHONPATH"] = ":".join(
@@ -104,15 +122,55 @@ def _platform_guard() -> str:
     return "cpu"
 
 
+def _degraded_json(platform: str, deadline: float) -> None:
+    """The deadline result is never a zero-information rc=124: emit the
+    result JSON in DEGRADED form (whatever partial numbers the run
+    produced, plus the phase it died in) so the driver always parses
+    something."""
+    mx = _metrics()
+    snap = mx.REGISTRY.snapshot()
+    gauges = snap.get("gauges", {})
+    rate = float(gauges.get("bench.throughput_tx_per_s", 0.0) or 0.0)
+    print(
+        json.dumps(
+            {
+                "metric": "zkatdlog_transfer_verify_throughput",
+                "value": round(rate, 2),
+                "unit": "tx/s",
+                "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
+                "platform": platform,
+                "degraded": True,
+                "deadline_s": deadline,
+                "phase": snap.get("meta", {}).get("progress.phase", "unknown"),
+                "stage_warmup_s": round(
+                    float(gauges.get("bench.stage_warmup_s", 0.0) or 0.0), 1
+                ),
+            }
+        ),
+        flush=True,
+    )
+
+
 def _arm_deadline(platform: str) -> None:
     """A sick tunnel can pass the device probe yet hang the first compile
-    or transfer forever. Arm a hard deadline: if the benchmark hasn't
-    printed its JSON by then, flush the metrics sidecar (so the run is
-    not a zero-information outcome), then on the axon platform re-exec
-    pinned to CPU so the driver always records a number."""
-    if platform == "cpu" and "FTS_BENCH_DEADLINE" not in os.environ:
-        return  # CPU runs have no fallback to arm unless explicitly asked
-    deadline = float(os.environ.get("FTS_BENCH_DEADLINE", "2400"))
+    or transfer forever — and a cold-cache CPU run can legitimately
+    outlast the DRIVER's own timeout, which kills the process with a
+    silent rc=124. Arm an internal deadline strictly INSIDE the driver
+    budget (default 2000s < the 2400s driver window; the post-re-exec CPU
+    child gets a short 300s budget since its parent already burned most
+    of the window): if the benchmark hasn't printed its JSON by then,
+    flush the metrics sidecar, emit a DEGRADED-but-parsed result JSON,
+    and on the axon platform re-exec pinned to CPU first."""
+    if "FTS_BENCH_DEADLINE" in os.environ:  # explicit always wins
+        deadline = float(os.environ["FTS_BENCH_DEADLINE"])
+    elif os.environ.get("_FTS_BENCH_REEXEC"):
+        # _reexec_cpu pops FTS_BENCH_DEADLINE; the watchdog re-exec sets
+        # FTS_BENCH_CHILD_DEADLINE=300 (parent burned the window), while
+        # an early probe-failure re-exec leaves it unset — that child
+        # still has nearly the whole driver budget
+        deadline = float(os.environ.get("FTS_BENCH_CHILD_DEADLINE", "1800"))
+    else:
+        deadline = 2000.0
 
     def watchdog():
         if _done.wait(timeout=deadline):
@@ -122,16 +180,123 @@ def _arm_deadline(platform: str) -> None:
         print(
             f"[fts-bench] DEADLINE after {deadline:.0f}s on platform="
             f"{platform}: flushing metrics sidecar and "
-            + ("re-exec'ing on CPU" if platform != "cpu" else "exiting 124"),
+            + (
+                "re-exec'ing on CPU"
+                if platform != "cpu"
+                else "emitting degraded result JSON"
+            ),
             file=sys.stderr,
             flush=True,
         )
         if platform != "cpu":
-            _reexec_cpu()  # owns the pre-exec sidecar flushes; no return
-        mx.flush_sidecar()  # already CPU (or re-exec refused): record...
-        os._exit(124)  # ...then fail loudly
+            # owns the pre-exec sidecar flushes; no return. The child
+            # gets only a short budget — this parent burned the window.
+            _reexec_cpu(child_deadline=300)
+        _degraded_json(platform, deadline)
+        mx.flush_sidecar()
+        os._exit(0)  # degraded JSON was printed: a parseable outcome
 
     threading.Thread(target=watchdog, daemon=True).start()
+
+
+def _block_throughput(pp, rng, hb) -> dict:
+    """Product-path benchmark: multi-tx blocks through the orderer.
+
+    Builds B real 2-in/2-out zkatdlog transfer REQUESTS (owner
+    signatures, MVCC inputs from a prior issue block) and submits them
+    through `Network.submit_many`, so the measured region is the whole
+    block pipeline: ordering -> same-shape grouping -> ONE
+    `BatchedTransferVerifier` call per group -> signature checks ->
+    intra-block MVCC -> atomic commit + finality. Opt out with
+    FTS_BENCH_BLOCK=0; FTS_BENCH_BLOCK_TXS sizes the block.
+    """
+    mx = _metrics()
+    n = int(os.environ.get("FTS_BENCH_BLOCK_TXS", "16"))
+    from fabric_token_sdk_tpu.api.request import (
+        IssueRecord,
+        TokenRequest,
+        TransferRecord,
+    )
+    from fabric_token_sdk_tpu.api.validator import RequestValidator
+    from fabric_token_sdk_tpu.crypto import sign
+    from fabric_token_sdk_tpu.drivers import identity
+    from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+    from fabric_token_sdk_tpu.models.token import ID
+    from fabric_token_sdk_tpu.services.network import BlockPolicy, Network
+
+    hb.set_phase("block_provegen", txs=n)
+    t0 = time.time()
+    driver = ZKATDLogDriver(pp)
+    net = Network(
+        RequestValidator(driver),
+        policy=BlockPolicy(max_block_txs=n, min_batch=1),
+    )
+    issuer_key, alice_key = sign.keygen(rng), sign.keygen(rng)
+    issuer_id = identity.pk_identity(issuer_key.public)
+    alice_id = identity.pk_identity(alice_key.public)
+
+    anchor = "bench-block-issue"
+    outcome = driver.issue(
+        issuer_id, "USD", [100, 55] * n, [alice_id] * (2 * n),
+        anonymous=False, rng=rng,
+    )
+    issue_req = TokenRequest(anchor=anchor)
+    issue_req.issues.append(
+        IssueRecord(
+            action=outcome.action_bytes, issuer=issuer_id,
+            outputs_metadata=outcome.metadata, receivers=[alice_id] * (2 * n),
+        )
+    )
+    issue_req.issues[0].signature = issuer_key.sign(
+        issue_req.marshal_to_sign(), rng
+    )
+
+    transfer_reqs = []
+    for i in range(n):
+        ids = [ID(anchor, 2 * i), ID(anchor, 2 * i + 1)]
+        tout = driver.transfer(
+            ids,
+            outcome.outputs[2 * i : 2 * i + 2],
+            outcome.metadata[2 * i : 2 * i + 2],
+            "USD", [120, 35], [alice_id, alice_id], rng=rng,
+        )
+        req = TokenRequest(anchor=f"bench-block-t{i}")
+        req.transfers.append(
+            TransferRecord(
+                action=tout.action_bytes, input_ids=ids,
+                senders=[alice_id, alice_id],
+                outputs_metadata=tout.metadata,
+                receivers=[alice_id, alice_id],
+            )
+        )
+        payload = req.marshal_to_sign()
+        req.transfers[0].signatures = [
+            alice_key.sign(payload, rng), alice_key.sign(payload, rng)
+        ]
+        transfer_reqs.append(req.to_bytes())
+    gen_s = time.time() - t0
+    mx.gauge("bench.block_provegen_s").set(round(gen_s, 3))
+
+    ev = net.submit(issue_req.to_bytes())
+    assert ev.status.value == "Valid", f"bench issue rejected: {ev.message}"
+
+    hb.set_phase("block_throughput", txs=n)
+    batched_before = mx.REGISTRY.counter("ledger.validate.batched").value
+    t0 = time.time()
+    events = net.submit_many(transfer_reqs)
+    elapsed = time.time() - t0
+    bad = [e for e in events if e.status.value != "Valid"]
+    assert not bad, f"bench block rejected {len(bad)} txs: {bad[0].message}"
+    batched = mx.REGISTRY.counter("ledger.validate.batched").value - batched_before
+    rate = n / elapsed
+    mx.gauge("bench.block_txs_per_s").set(round(rate, 2))
+    return {
+        "block_txs_per_s": round(rate, 2),
+        "block_vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
+        "block_txs": n,
+        "block_batched_frac": round(batched / n, 3),
+        "block_provegen_s": round(gen_s, 1),
+    }
 
 
 def main() -> None:
@@ -205,32 +370,48 @@ def main() -> None:
     elapsed = time.time() - t0
     rate = B * runs / elapsed
 
-    hb.set_phase("done")
     mx.gauge("bench.throughput_tx_per_s").set(round(rate, 2))
     mx.gauge("bench.warmup_s").set(round(warm_s, 3))
     mx.gauge("bench.provegen_s").set(round(gen_s, 3))
     mx.gauge("bench.setup_s").set(round(setup_s, 3))
-    print(
-        json.dumps(
-            {
-                "metric": "zkatdlog_transfer_verify_throughput",
-                "value": round(rate, 2),
-                "unit": "tx/s",
-                "vs_baseline": round(rate / 133.0, 3),
-                "platform": platform,
-                "batch": B,
-                "runs": runs,
-                "warmup_s": round(warm_s, 1),
-                "provegen_s": round(gen_s, 1),
-                "setup_s": round(setup_s, 1),
-                "stage_warmup_s": round(
-                    float(mx.REGISTRY.gauge("bench.stage_warmup_s").value or 0), 1
-                ),
-            }
+
+    result = {
+        "metric": "zkatdlog_transfer_verify_throughput",
+        "value": round(rate, 2),
+        "unit": "tx/s",
+        "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
+        "platform": platform,
+        "batch": B,
+        "runs": runs,
+        "warmup_s": round(warm_s, 1),
+        "provegen_s": round(gen_s, 1),
+        "setup_s": round(setup_s, 1),
+        "stage_warmup_s": round(
+            float(mx.REGISTRY.gauge("bench.stage_warmup_s").value or 0), 1
         ),
-        flush=True,
-    )
+    }
+    # The headline is secured the moment it exists: print it (and disarm
+    # the watchdog) BEFORE the fallible block phase, so a hang or crash
+    # there can never cost the completed accelerator measurement.
+    print(json.dumps(result), flush=True)
     _done.set()
+
+    # product-path block pipeline (orderer + batched block validation);
+    # on success, ONE more enriched JSON line supersedes the headline for
+    # last-line parsers (it is a strict superset of the same fields)
+    if os.environ.get("FTS_BENCH_BLOCK", "1") != "0":
+        try:
+            result.update(_block_throughput(pp, rng, hb))
+            print(json.dumps(result), flush=True)
+        except Exception as e:  # pragma: no cover
+            print(
+                f"[fts-bench] block_throughput phase failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    hb.set_phase("done")
     hb.stop()
     mx.flush_sidecar()
 

@@ -109,6 +109,21 @@ def show(path: str, prometheus: bool = False) -> None:
         f" load_failures={ctr.get('jax.cache.load_failures', 0)}"
     )
 
+    # one-line block-pipeline health: how much of the validate plane rode
+    # the batched device path vs the host fallback
+    blocks = ctr.get("ledger.blocks.committed", 0)
+    if blocks:
+        bsize = d.get("histograms", {}).get("ledger.block.size", {})
+        txs = int(bsize.get("sum", 0))
+        batched = ctr.get("ledger.validate.batched", 0)
+        host = ctr.get("ledger.validate.host", 0)
+        frac = batched / (batched + host) if (batched + host) else 0.0
+        print(
+            f"block summary: blocks={blocks} txs={txs}"
+            f" txs_per_block={txs / blocks:.1f}"
+            f" batched={batched} host={host} batched_frac={frac:.2f}"
+        )
+
     _print_kv(
         "gauges",
         sorted(d.get("gauges", {}).items()),

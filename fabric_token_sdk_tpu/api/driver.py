@@ -29,6 +29,11 @@ def vguard(fn):
             return fn(*args, **kwargs)
         except ValidationError:
             raise
+        except (MemoryError, OSError):
+            # transient environment faults are NOT validation verdicts:
+            # they must reach the ledger's transient path (attempt fails,
+            # nothing durable recorded, resubmission can succeed)
+            raise
         except Exception as e:
             raise ValidationError(
                 f"malformed action: {type(e).__name__}: {e}"
@@ -97,10 +102,31 @@ class Driver(abc.ABC):
                           resolve_input,  # Callable[[ID], bytes]
                           signed_payload: bytes,
                           signatures: Sequence[bytes],
-                          now: Optional[float] = None) -> Tuple[List[ID], List[bytes]]:
+                          now: Optional[float] = None,
+                          proof_verified: Optional[bool] = None,
+                          ) -> Tuple[List[ID], List[bytes]]:
         """Validate a transfer action; returns (spent ids, outputs to write).
         `now` is the deterministic commit timestamp (script deadlines etc.
-        must not depend on validator wall clocks)."""
+        must not depend on validator wall clocks). `proof_verified` is the
+        block-batched plane's verdict on the action's ZK proof — True:
+        skip the host proof check, False: reject, None: verify on host.
+        Drivers without ZK proofs ignore it (their `transfer_batch_plan`
+        never emits a plan, so it is always None for them)."""
+
+    # ------------------------------------------------------------ batching
+
+    def transfer_batch_plan(self, action_bytes: bytes):
+        """Optional hook for the block-batched validation plane: return
+        `(shape_key, row)` where all rows sharing `shape_key` can be
+        verified together in ONE `batch_verifier().verify(rows)` call, or
+        None to route this action through the host path (default)."""
+        return None
+
+    def batch_verifier(self):
+        """The driver's block-batched transfer-proof verifier (an object
+        with `verify(rows) -> bool array`), or None when the driver has
+        no batched plane (default)."""
+        return None
 
     # ------------------------------------------------------------ tokens
 

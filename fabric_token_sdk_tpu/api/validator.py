@@ -8,7 +8,7 @@ Endorsers/committers run this against current ledger state.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .driver import Driver, ValidationError
 from .request import TokenRequest
@@ -29,8 +29,17 @@ class RequestValidator:
         self.auditor = auditor_identity
 
     def validate(self, request: TokenRequest, resolve_input: Callable[[ID], bytes],
-                 now=None) -> ValidationResult:
-        """`now`: deterministic commit timestamp for time-locked scripts."""
+                 now=None,
+                 transfer_proofs: Optional[Dict[int, bool]] = None) -> ValidationResult:
+        """`now`: deterministic commit timestamp for time-locked scripts.
+
+        `transfer_proofs`: verdicts from the block-batched proof plane,
+        keyed by transfer-record index — True means the action's ZK proof
+        was already verified on the device (the driver skips its host
+        proof check), False means it was already REJECTED. Records with
+        no verdict verify on host. Everything else (ledger-input
+        matching, ownership signatures, conservation) always runs here.
+        """
         result = ValidationResult()
         payload = request.marshal_to_sign()
 
@@ -57,9 +66,11 @@ class RequestValidator:
                     raise ValidationError(f"invalid issuer signature: {e}") from e
             result.outputs.append(("issue", outputs))
 
-        for rec in request.transfers:
+        for idx, rec in enumerate(request.transfers):
             spent, outputs = self.driver.validate_transfer(
-                rec.action, resolve_input, payload, rec.signatures, now=now
+                rec.action, resolve_input, payload, rec.signatures, now=now,
+                proof_verified=None if transfer_proofs is None
+                else transfer_proofs.get(idx),
             )
             if spent != rec.input_ids:
                 raise ValidationError("transfer record ids do not match action")

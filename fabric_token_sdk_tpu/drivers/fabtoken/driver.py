@@ -131,7 +131,9 @@ class FabTokenDriver(Driver):
 
     @vguard
     def validate_transfer(self, action_bytes, resolve_input, signed_payload,
-                          signatures, now=None):
+                          signatures, now=None, proof_verified=None):
+        # fabtoken carries no ZK proof: `transfer_batch_plan` never emits
+        # a plan, so `proof_verified` is always None here and ignored
         d = loads(action_bytes)
         ids = [ID(t, i) for t, i in d["ids"]]
         if not ids:

@@ -1,1 +1,2 @@
 from .ledger import Block, FinalityEvent, Network, TxStatus  # noqa: F401
+from .orderer import BlockPolicy, Orderer, Submission  # noqa: F401

@@ -1,10 +1,14 @@
-"""In-memory token ledger with MVCC double-spend detection + finality events.
+"""In-memory token ledger: multi-tx blocks, MVCC, finality events.
 
 Reference: `token/services/network/*` (fabric/orion backends + vault
-processor). Ours is a deterministic single-process ledger: an ordering
-queue serializes commits; each commit re-validates the request against
-current state, detects conflicts (already-spent inputs — the distributed
-"race"), applies writes atomically, and notifies finality listeners.
+processor) plus the ordering service in front of them. Submissions enter
+the `Orderer`'s queue (`orderer.py`); blocks are cut by size/linger
+policy and validated by the block pipeline — same-shape zkatdlog
+transfer groups in ONE `BatchedTransferVerifier` call over the
+compile-once stage tiles, host `RequestValidator` for the rest — then
+committed atomically: intra-block MVCC (a double-spend inside a block
+invalidates the LATER tx only), per-tx finality events, and
+crash-isolated listener notification.
 """
 
 from __future__ import annotations
@@ -17,10 +21,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ...api.driver import ValidationError
 from ...api.request import TokenRequest
-from ...api.validator import RequestValidator
+from ...api.validator import RequestValidator, ValidationResult
 from ...models.token import ID
 from ...utils import metrics as mx
-from ...utils.tracing import tracer
+from ...utils.tracing import logger, tracer
+from .orderer import BlockPolicy, BlockValidationPipeline, Orderer, Submission
 
 
 class TxStatus(Enum):
@@ -34,6 +39,10 @@ class FinalityEvent:
     tx_id: str
     status: TxStatus
     message: str = ""
+    # True: the rejection was an INTERNAL fault, not a deterministic
+    # verdict — the submitter sees it, but nothing durable is recorded
+    # (an identical resubmission may succeed). Never persisted.
+    transient: bool = False
 
 
 @dataclass
@@ -43,17 +52,64 @@ class Block:
     timestamp: float = 0.0
 
 
+class _BlockView:
+    """MVCC overlay for one block: txs validate against committed state
+    PLUS the writes of earlier valid txs in the same block. Outputs
+    created earlier in the block are spendable; inputs consumed earlier
+    in the block are conflicts (the later tx is invalidated). Nothing
+    touches the committed maps until `merge()` — the block applies
+    atomically or (on a crash mid-validate) not at all."""
+
+    def __init__(self, state: Dict[str, bytes], spent: set):
+        self._state = state
+        self._spent = spent
+        self._new: Dict[str, bytes] = {}
+        self._consumed: set = set()
+
+    def resolve(self, token_id: ID) -> bytes:
+        key = token_id.key()
+        if key in self._consumed or key in self._spent:
+            raise ValidationError(f"token {token_id} already spent")
+        raw = self._new.get(key)
+        if raw is None:
+            raw = self._state.get(key)
+        if raw is None:
+            raise ValidationError(f"token {token_id} does not exist")
+        return raw
+
+    def apply(self, tx_id: str, result: ValidationResult) -> None:
+        for token_id in result.spent:
+            key = token_id.key()
+            self._consumed.add(key)
+            self._new.pop(key, None)
+        out_index = 0
+        for _, outputs in result.outputs:
+            for raw in outputs:
+                self._new[ID(tx_id, out_index).key()] = raw
+                out_index += 1
+
+    def merge(self) -> None:
+        for key in self._consumed:
+            self._state.pop(key, None)
+            self._spent.add(key)
+        self._state.update(self._new)
+
+
 class Network:
     """Shared ledger + orderer for a set of parties."""
 
-    def __init__(self, validator: RequestValidator):
+    def __init__(self, validator: RequestValidator,
+                 policy: Optional[BlockPolicy] = None):
         self.validator = validator
+        self.policy = policy or BlockPolicy.from_env()
         self._state: Dict[str, bytes] = {}  # token key -> output bytes
         self._spent: set = set()  # token keys consumed (serials)
         self._blocks: List[Block] = []
         self._status: Dict[str, FinalityEvent] = {}
         self._listeners: List[Callable[[FinalityEvent, TokenRequest], None]] = []
         self._lock = threading.Lock()
+        self._pipeline = BlockValidationPipeline(validator, self.policy)
+        self._orderer = Orderer(self._commit_block, self.policy)
 
     # ------------------------------------------------------------ queries
 
@@ -79,59 +135,182 @@ class Network:
         with self._lock:
             return len(self._blocks)
 
-    # ------------------------------------------------------------ commit
+    def block(self, number: int) -> Optional[Block]:
+        with self._lock:
+            return self._blocks[number] if 0 <= number < len(self._blocks) else None
+
+    # ------------------------------------------------------------ ordering
 
     def subscribe(self, listener: Callable[[FinalityEvent, TokenRequest], None]) -> None:
         self._listeners.append(listener)
 
     def submit(self, request_bytes: bytes) -> FinalityEvent:
-        """Order + validate + commit one token request (one tx per block).
+        """Order + validate + commit one token request; blocks until the
+        block containing it commits (driving the group commit if this
+        caller wins the race). Returns the finality event (also pushed to
+        subscribers)."""
+        sub = self.submit_async(request_bytes)
+        with tracer.span("network.submit", tx=sub.request.anchor):
+            return sub.result()
 
-        Mirrors ordering -> endorser validation -> vault commit. Returns the
-        finality event (also pushed to subscribers).
-        """
+    def submit_async(self, request_bytes: bytes) -> Submission:
+        """Enqueue a request into ordering; returns a Submission handle
+        whose `result()` waits for (and, if needed, drives) block commit."""
         request = TokenRequest.from_bytes(request_bytes)
-        tx_id = request.anchor
-        with tracer.span("network.submit", tx=tx_id):
-            with self._lock:
-                if tx_id in self._status:
-                    mx.counter("network.submit.resubmissions").inc()
-                    return self._status[tx_id]  # idempotent resubmission
-                commit_time = time.time()
-                try:
-                    with mx.span("network.validate", tx=tx_id):
-                        result = self.validator.validate(
-                            request, self._resolve_locked, now=commit_time
-                        )
-                    # MVCC conflict check happens inside _resolve_locked;
-                    # apply atomically
-                    for token_id in result.spent:
-                        self._spent.add(token_id.key())
-                        del self._state[token_id.key()]
-                    out_index = 0
-                    for _, outputs in result.outputs:
-                        for raw in outputs:
-                            self._state[ID(tx_id, out_index).key()] = raw
-                            out_index += 1
-                    event = FinalityEvent(tx_id, TxStatus.VALID)
-                    mx.counter("network.tx.valid").inc()
-                except ValidationError as e:
-                    event = FinalityEvent(tx_id, TxStatus.INVALID, str(e))
-                    mx.counter("network.tx.invalid").inc()
-                self._status[tx_id] = event
-                self._blocks.append(Block(len(self._blocks), [tx_id], commit_time))
-                mx.gauge("network.height").set(len(self._blocks))
-            for listener in self._listeners:
-                listener(event, request)
-            return event
+        with self._lock:
+            known = self._status.get(request.anchor)
+        if known is not None:  # idempotent resubmission
+            mx.counter("network.submit.resubmissions").inc()
+            sub = Submission(None, request)
+            sub._resolve(known)
+            return sub
+        return self._orderer.enqueue(request)
 
-    def _resolve_locked(self, token_id: ID) -> bytes:
-        key = token_id.key()
-        if key in self._spent:
-            raise ValidationError(f"token {token_id} already spent")
-        if key not in self._state:
-            raise ValidationError(f"token {token_id} does not exist")
-        return self._state[key]
+    def submit_many(self, requests_bytes: List[bytes]) -> List[FinalityEvent]:
+        """Deterministic multi-tx blocks: enqueue everything, then cut +
+        commit in arrival order (`max_block_txs` txs per block)."""
+        subs = [self.submit_async(rb) for rb in requests_bytes]
+        self._orderer.flush()
+        return [s.result() for s in subs]
+
+    def flush(self) -> None:
+        """Force-commit everything pending in the ordering queue."""
+        self._orderer.flush()
+
+    # ------------------------------------------------------------ commit
+
+    def _commit_block(self, subs: List[Submission]) -> None:
+        """Validate + commit one cut block (called under the orderer's
+        commit lock, which serializes commits end to end). Every
+        submission in the cut is GUARANTEED a resolution — even on an
+        internal crash — or its waiters would spin forever."""
+        try:
+            self._commit_block_inner(subs)
+        finally:
+            stranded = [s for s in subs if not s.done()]
+            if stranded:  # internal error escaped: fail them loudly
+                mx.counter("ledger.commit.stranded").inc(len(stranded))
+                for sub in stranded:
+                    sub._resolve(
+                        FinalityEvent(
+                            sub.request.anchor, TxStatus.INVALID,
+                            "internal commit error (see ledger logs)",
+                            transient=True,
+                        )
+                    )
+
+    def _commit_block_inner(self, subs: List[Submission]) -> None:
+        fresh: List[Submission] = []
+        dup_of: Dict[str, List[Submission]] = {}
+        with self._lock:
+            for sub in subs:
+                anchor = sub.request.anchor
+                known = self._status.get(anchor)
+                if known is not None:
+                    mx.counter("network.submit.resubmissions").inc()
+                    sub._resolve(known)
+                elif anchor in dup_of:
+                    # same anchor twice in one cut: validate once
+                    mx.counter("network.submit.resubmissions").inc()
+                    dup_of[anchor].append(sub)
+                else:
+                    fresh.append(sub)
+                    dup_of[anchor] = []
+        if not fresh:
+            return
+        requests = [s.request for s in fresh]
+        with mx.span("ledger.block.validate", txs=len(requests)):
+            # Validation runs OUTSIDE the ledger lock: the device verify
+            # (or a cold compile) and the per-tx host checks must not
+            # starve concurrent reads. This is safe because the orderer's
+            # commit lock serializes every state WRITER — readers under
+            # `self._lock` simply observe consistent pre-block state
+            # until the atomic merge below.
+            verdicts = self._pipeline.proof_verdicts(requests)
+            commit_time = time.time()
+            view = _BlockView(self._state, self._spent)
+            events: List[FinalityEvent] = []
+            for ti, request in enumerate(requests):
+                events.append(
+                    self._validate_tx(request, view, commit_time, verdicts.get(ti))
+                )
+            with self._lock:
+                # atomic apply + finalize; transient-fault events resolve
+                # their submitter but leave no durable trace
+                view.merge()
+                block = Block(
+                    len(self._blocks),
+                    [e.tx_id for e in events if not e.transient],
+                    commit_time,
+                )
+                self._blocks.append(block)
+                for event in events:
+                    if not event.transient:
+                        self._status[event.tx_id] = event
+                self._record_block_metrics(requests, events, verdicts)
+        # listeners run outside the ledger lock; resolve afterwards so a
+        # submitter returning from submit() sees vault/db effects applied
+        for event, request in zip(events, requests):
+            if not event.transient:
+                self._notify(event, request)
+        for sub, event in zip(fresh, events):
+            sub._resolve(event)
+            for dup in dup_of.get(event.tx_id, ()):
+                dup._resolve(event)
+
+    def _validate_tx(self, request: TokenRequest, view: _BlockView,
+                     commit_time: float,
+                     proofs: Optional[Dict[int, bool]]) -> FinalityEvent:
+        tx_id = request.anchor
+        try:
+            with mx.span("network.validate", tx=tx_id):
+                result = self.validator.validate(
+                    request, view.resolve, now=commit_time,
+                    transfer_proofs=proofs,
+                )
+            view.apply(tx_id, result)
+            mx.counter("network.tx.valid").inc()
+            return FinalityEvent(tx_id, TxStatus.VALID)
+        except ValidationError as e:
+            mx.counter("network.tx.invalid").inc()
+            return FinalityEvent(tx_id, TxStatus.INVALID, str(e))
+        except Exception as e:  # defensive: one bad tx never aborts a block
+            logger.exception("ledger: unexpected validation error for %s", tx_id)
+            mx.counter("ledger.validate.unexpected_errors").inc()
+            mx.counter("network.tx.invalid").inc()
+            return FinalityEvent(
+                tx_id, TxStatus.INVALID,
+                f"internal validation error: {type(e).__name__}: {e}",
+                transient=True,
+            )
+
+    def _record_block_metrics(self, requests, events, verdicts) -> None:
+        mx.counter("ledger.blocks.committed").inc()
+        mx.histogram(
+            "ledger.block.size", buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)
+        ).observe(len(requests))
+        batched = sum(len(v) for v in verdicts.values())
+        transfers = sum(len(r.transfers) for r in requests)
+        mx.counter("ledger.validate.batched").inc(batched)
+        mx.counter("ledger.validate.host").inc(transfers - batched)
+        if transfers:
+            mx.histogram(
+                "ledger.block.batched_frac",
+                buckets=(0.0, 0.25, 0.5, 0.75, 0.9, 1.0),
+            ).observe(batched / transfers)
+        mx.gauge("network.height").set(len(self._blocks))
+
+    def _notify(self, event: FinalityEvent, request: TokenRequest) -> None:
+        """Per-listener crash isolation: a throwing finality listener is
+        counted and logged, never allowed to abort the commit loop."""
+        for listener in self._listeners:
+            try:
+                listener(event, request)
+            except Exception:
+                mx.counter("ledger.listener.errors").inc()
+                logger.exception(
+                    "ledger: finality listener failed for tx %s", event.tx_id
+                )
 
     # --------------------------------------------------- checkpoint/resume
 
@@ -154,11 +333,12 @@ class Network:
             )
 
     @classmethod
-    def restore(cls, validator: RequestValidator, raw: bytes) -> "Network":
+    def restore(cls, validator: RequestValidator, raw: bytes,
+                policy: Optional[BlockPolicy] = None) -> "Network":
         from ...crypto.serialization import loads
 
         d = loads(raw)
-        net = cls(validator)
+        net = cls(validator, policy=policy)
         net._state = dict(d["state"])
         net._spent = set(d["spent"])
         net._blocks = [Block(*row) for row in d["blocks"]]

@@ -19,6 +19,7 @@ from ...api.request import TokenRequest
 from ...api.validator import RequestValidator
 from ...models.token import ID
 from .ledger import FinalityEvent, Network, TxStatus
+from .orderer import Submission
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -47,8 +48,10 @@ class LedgerServer:
     """Hosts a Network (orderer + endorser + committer) over TCP."""
 
     def __init__(self, validator: RequestValidator, host: str = "127.0.0.1",
-                 port: int = 0):
-        self.network = Network(validator)
+                 port: int = 0, policy=None):
+        # concurrent client submits land in the node's ordering queue and
+        # group-commit into shared blocks (policy: orderer.BlockPolicy)
+        self.network = Network(validator, policy=policy)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -132,6 +135,16 @@ class RemoteNetwork:
         for listener in self._listeners:
             listener(event, request)
         return event
+
+    def submit_async(self, request_bytes: bytes) -> Submission:
+        """API parity with the in-process `Network`: the wire protocol is
+        request/response, so ordering happens server-side (the node's own
+        Orderer batches concurrent submitters) and the handle returned
+        here is already resolved."""
+        event = self.submit(request_bytes)
+        sub = Submission(None, TokenRequest.from_bytes(request_bytes))
+        sub._resolve(event)
+        return sub
 
     def resolve_input(self, token_id: ID) -> bytes:
         resp = self._call({"op": "resolve", "tx_id": token_id.tx_id, "index": token_id.index})

@@ -7,34 +7,25 @@ written for TPU; CPU execution exercises identical XLA programs.
 import os
 import sys
 
-# Hard-force CPU: the ambient environment pins JAX_PLATFORMS=axon (remote
-# TPU tunnel via the sitecustomize in /root/.axon_site, triggered by
-# PALLAS_AXON_POOL_IPS). The axon PJRT client is registered at interpreter
-# startup and hangs every jax call when the tunnel is down — too late to
-# undo from here. Re-exec the test process once with a cleaned env so
-# tests are local, fast, and tunnel-independent. bench.py is the only
-# entry point that targets the real chip.
-if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get("_FTS_TPU_REEXEC"):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["_FTS_TPU_REEXEC"] = "1"
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
-    )
-    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 # The package ships without an installer; the repo root on sys.path is
 # what makes `fabric_token_sdk_tpu` (and `import __graft_entry__`)
 # importable from any pytest invocation directory.
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# Hard-force CPU: the ambient environment pins JAX_PLATFORMS=axon (remote
+# TPU tunnel via the sitecustomize in /root/.axon_site, triggered by
+# PALLAS_AXON_POOL_IPS). The axon PJRT client is registered at interpreter
+# startup and hangs every jax call when the tunnel is down — too late to
+# undo from here. The re-exec-once-with-a-cleaned-env logic lives in
+# __graft_entry__.neutralize_axon (shared with the standalone driver
+# entry points); tests pass the pytest re-exec argv explicitly. bench.py
+# is the only entry point that targets the real chip.
+import __graft_entry__ as _graft
+
+_graft.neutralize_axon(["-m", "pytest"] + sys.argv[1:])
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests hard-force CPU, always
+_graft.ensure_virtual_devices(8)  # sharding tests need the virtual mesh
 
 # Persistent XLA compilation cache is configured centrally in
 # fabric_token_sdk_tpu/ops/__init__.py (~/.cache/fts_tpu_jax); kernels are

@@ -10,6 +10,7 @@ The `jax.core.compile.backend_compile_duration` histogram (registered in
 the budget directly.
 """
 
+import os
 import random
 
 import numpy as np
@@ -106,6 +107,50 @@ def test_wf_verifier_is_transfer_shape_invariant(rng, pp):
     assert _compiles() - before == 0, (
         "a new (n_in, n_out) shape compiled new XLA programs — the staged "
         "WF path must be shape-invariant"
+    )
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTS_WARMUP") != "1",
+    reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",
+)
+def test_block_validation_compiles_zero_programs_after_warmup(rng, pp):
+    """Non-slow guard for the ORDERER's batched plane: after the session
+    warmup precompiled the canonical program set, committing a block of
+    same-shape zkatdlog transfers through `Network.submit_many` (grouping
+    -> BatchedTransferVerifier -> MVCC commit) must MISS the compilation
+    cache zero times — the product path never pays a surprise compile."""
+    from test_orderer import build_env, issue_to, manual_transfer
+    from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+    from fabric_token_sdk_tpu.services.network import BlockPolicy
+
+    network, parties, issuer, alice, bob = build_env(
+        lambda: ZKATDLogDriver(pp), BlockPolicy(max_block_txs=8, min_batch=2)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [5] * 4, "cb-seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"cb-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+
+    bt_before = mx.REGISTRY.counter("batch.transfer.txs").value
+    misses_before = mx.REGISTRY.counter(
+        "jax.compilation_cache.cache_misses"
+    ).value
+    events = network.submit_many([r.to_bytes() for r in reqs])
+    assert all(e.status.value == "Valid" for e in events)
+    # the block really rode the device plane...
+    assert mx.REGISTRY.counter("batch.transfer.txs").value - bt_before == 4
+    # ...and it compiled nothing new
+    misses = (
+        mx.REGISTRY.counter("jax.compilation_cache.cache_misses").value
+        - misses_before
+    )
+    assert misses == 0, (
+        f"block validation missed the compilation cache {misses} time(s) "
+        "after warmup() — the orderer's batched plane escaped the "
+        "canonical program set"
     )
 
 

@@ -384,7 +384,8 @@ def test_bench_sidecar_flushed_on_sigterm(tmp_path):
 def test_bench_sidecar_flushed_on_deadline(tmp_path):
     """Simulated timeout via a short FTS_BENCH_DEADLINE: the watchdog
     must log to stderr, flush the sidecar with per-phase wall times and
-    compile/cache counters, and exit non-zero."""
+    compile/cache counters, and print a DEGRADED-but-parsed result JSON
+    (exit 0) instead of dying as a silent rc=124."""
     proc, sidecar = _spawn_bench(tmp_path, {"FTS_BENCH_DEADLINE": "8"})
     try:
         proc.wait(timeout=300)
@@ -392,8 +393,14 @@ def test_bench_sidecar_flushed_on_deadline(tmp_path):
         if proc.poll() is None:
             proc.kill()
         out, err = proc.communicate(timeout=30)
-    assert proc.returncode == 124, f"expected rc=124, got {proc.returncode}; stderr tail: {err[-2000:]}"
+    assert proc.returncode == 0, f"expected rc=0 with degraded JSON, got {proc.returncode}; stderr tail: {err[-2000:]}"
     assert "DEADLINE" in err
+    # the driver can parse the outcome: degraded JSON with the live phase
+    degraded = json.loads(out.strip().splitlines()[-1])
+    assert degraded["degraded"] is True
+    assert degraded["metric"] == "zkatdlog_transfer_verify_throughput"
+    assert degraded["deadline_s"] == 8.0
+    assert "phase" in degraded
     assert os.path.exists(sidecar), "deadline did not flush the sidecar"
     d = json.loads(open(sidecar).read())
     assert d["meta"]["deadline_fired_s"] == 8.0
