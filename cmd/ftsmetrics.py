@@ -124,6 +124,24 @@ def show(path: str, prometheus: bool = False) -> None:
             f" batched={batched} host={host} batched_frac={frac:.2f}"
         )
 
+    # one-line durability health: journal traffic, recovery/torn-tail
+    # events, injected chaos, and client-side retry pressure
+    wal_appends = ctr.get("wal.appends", 0)
+    faults_injected = sum(
+        v for k, v in ctr.items() if k.startswith("faults.injected.")
+    )
+    retries = sum(v for k, v in ctr.items() if k.startswith("remote.retry."))
+    if wal_appends or faults_injected or retries or ctr.get("wal.recoveries", 0):
+        print(
+            f"durability summary: wal_appends={wal_appends}"
+            f" replayed={ctr.get('wal.replayed.records', 0)}"
+            f" torn_tails={ctr.get('wal.torn_tails', 0)}"
+            f" snapshots={ctr.get('wal.snapshots', 0)}"
+            f" recoveries={ctr.get('wal.recoveries', 0)}"
+            f" faults_injected={faults_injected}"
+            f" remote_retries={retries}"
+        )
+
     _print_kv(
         "gauges",
         sorted(d.get("gauges", {}).items()),

@@ -1,2 +1,3 @@
 from .ledger import Block, FinalityEvent, Network, TxStatus  # noqa: F401
 from .orderer import BlockPolicy, Orderer, Submission  # noqa: F401
+from .wal import WALError, WriteAheadLog  # noqa: F401

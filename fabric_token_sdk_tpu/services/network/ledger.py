@@ -9,10 +9,20 @@ compile-once stage tiles, host `RequestValidator` for the rest — then
 committed atomically: intra-block MVCC (a double-spend inside a block
 invalidates the LATER tx only), per-tx finality events, and
 crash-isolated listener notification.
+
+Durability (`wal.py`): when constructed with a `wal_path`, every cut
+block is appended to an fsync'd CRC-framed write-ahead log *before* the
+atomic merge, and a full snapshot is written every `snapshot_every`
+blocks (compaction: the WAL's replayed prefix is truncated only after
+the snapshot is durably on disk). `Network.recover(validator, path)`
+rebuilds the ledger from the latest snapshot plus the WAL suffix, with
+torn-tail tolerance — a node can be SIGKILLed mid-block and restart
+without losing any finality it ever reported.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -23,9 +33,11 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator, ValidationResult
 from ...models.token import ID
+from ...utils import faults
 from ...utils import metrics as mx
 from ...utils.tracing import logger, tracer
 from .orderer import BlockPolicy, BlockValidationPipeline, Orderer, Submission
+from .wal import WALError, WriteAheadLog
 
 
 class TxStatus(Enum):
@@ -99,7 +111,9 @@ class Network:
     """Shared ledger + orderer for a set of parties."""
 
     def __init__(self, validator: RequestValidator,
-                 policy: Optional[BlockPolicy] = None):
+                 policy: Optional[BlockPolicy] = None,
+                 wal_path: Optional[str] = None,
+                 snapshot_every: Optional[int] = None):
         self.validator = validator
         self.policy = policy or BlockPolicy.from_env()
         self._state: Dict[str, bytes] = {}  # token key -> output bytes
@@ -110,6 +124,17 @@ class Network:
         self._lock = threading.Lock()
         self._pipeline = BlockValidationPipeline(validator, self.policy)
         self._orderer = Orderer(self._commit_block, self.policy)
+        # durability plane: journal + snapshot compaction (wal.py). For an
+        # EXISTING journal use `Network.recover(...)` — constructing with
+        # a non-empty wal_path appends after whatever is already there.
+        self.snapshot_every = (
+            int(os.environ.get("FTS_WAL_SNAPSHOT_EVERY", "64"))
+            if snapshot_every is None else snapshot_every
+        )
+        self._wal: Optional[WriteAheadLog] = (
+            WriteAheadLog(wal_path) if wal_path else None
+        )
+        self._snapshot_path = (str(wal_path) + ".snap") if wal_path else None
 
     # ------------------------------------------------------------ queries
 
@@ -234,6 +259,16 @@ class Network:
                 events.append(
                     self._validate_tx(request, view, commit_time, verdicts.get(ti))
                 )
+            faults.fire("ledger.commit_block")
+            # WAL append BEFORE the atomic merge: once the record is
+            # fsync'd the block is durable — a crash between here and the
+            # merge redoes it on recovery (clients that never got an
+            # answer re-learn the verdict via status()). A crash before
+            # here loses only unacknowledged work.
+            if self._wal is not None:
+                self._wal.append(
+                    self._wal_record(requests, events, view, commit_time)
+                )
             with self._lock:
                 # atomic apply + finalize; transient-fault events resolve
                 # their submitter but leave no durable trace
@@ -248,6 +283,23 @@ class Network:
                     if not event.transient:
                         self._status[event.tx_id] = event
                 self._record_block_metrics(requests, events, verdicts)
+        # snapshot compaction: still under the orderer's commit lock (the
+        # only WAL writer), outside the ledger lock (snapshot() retakes
+        # it). The block is already durable in the journal by now, so a
+        # compaction failure must never poison its acknowledgement — the
+        # journal just keeps growing until a later compaction succeeds.
+        if (
+            self._wal is not None
+            and self.snapshot_every > 0
+            and len(self._blocks) % self.snapshot_every == 0
+        ):
+            try:
+                self._compact()
+            except Exception:
+                mx.counter("wal.snapshot_failures").inc()
+                logger.exception(
+                    "ledger: snapshot compaction failed; journal keeps growing"
+                )
         # listeners run outside the ledger lock; resolve afterwards so a
         # submitter returning from submit() sees vault/db effects applied
         for event, request in zip(events, requests):
@@ -300,6 +352,52 @@ class Network:
             ).observe(batched / transfers)
         mx.gauge("network.height").set(len(self._blocks))
 
+    # ------------------------------------------------------------ durability
+
+    def _wal_record(self, requests, events, view: _BlockView,
+                    commit_time: float) -> bytes:
+        """One journal record = one cut block: the raw request bytes (for
+        audit/replay), the per-tx verdicts, and the exact durable state
+        delta the merge will apply. Replay applies the delta — it never
+        re-validates, so recovery is deterministic and cheap regardless
+        of how expensive the original proofs were. Transient (internal-
+        fault) events leave no durable trace here either."""
+        from ...crypto.serialization import dumps
+
+        return dumps(
+            {
+                "height": len(self._blocks),
+                "ts": commit_time,
+                "requests": [r.to_bytes() for r in requests],
+                "txs": [
+                    [e.tx_id, e.status.value, e.message]
+                    for e in events if not e.transient
+                ],
+                "consumed": sorted(view._consumed),
+                "outputs": dict(view._new),
+            }
+        )
+
+    def _compact(self) -> None:
+        """Write a full snapshot (atomic tmp+rename, fsync'd — including
+        the DIRECTORY, so the rename is durable before the truncate can
+        be) and only then truncate the journal. A crash in between
+        leaves snapshot AND journal, whose replayed prefix is skipped by
+        height."""
+        from .wal import fsync_dir
+
+        raw = self.snapshot()
+        tmp = f"{self._snapshot_path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(raw)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._snapshot_path)
+        if self._wal.sync:
+            fsync_dir(self._snapshot_path)
+        self._wal.reset()
+        mx.counter("wal.snapshots").inc()
+
     def _notify(self, event: FinalityEvent, request: TokenRequest) -> None:
         """Per-listener crash isolation: a throwing finality listener is
         counted and logged, never allowed to abort the commit loop."""
@@ -345,4 +443,67 @@ class Network:
         net._status = {
             t: FinalityEvent(t, TxStatus(s), m) for t, (s, m) in d["status"].items()
         }
+        return net
+
+    @classmethod
+    def recover(cls, validator: RequestValidator, wal_path: str,
+                policy: Optional[BlockPolicy] = None,
+                snapshot_every: Optional[int] = None) -> "Network":
+        """Rebuild a crashed node's ledger: latest snapshot (if any) plus
+        a replay of the WAL suffix, then keep journaling to the same
+        files. Records at heights the snapshot already covers are skipped
+        (the crash-between-snapshot-and-truncate window); a torn final
+        record is discarded by `WriteAheadLog.replay`. A height GAP means
+        the journal lost acknowledged blocks — that is unrecoverable and
+        raises `WALError` rather than resurrecting a forked ledger."""
+        from ...crypto.serialization import loads
+
+        snap_path = str(wal_path) + ".snap"
+        if os.path.exists(snap_path):
+            with open(snap_path, "rb") as fh:
+                net = cls.restore(validator, fh.read(), policy=policy)
+        else:
+            net = cls(validator, policy=policy)
+        wal = WriteAheadLog(wal_path)
+        replayed = 0
+        for raw in wal.replay():
+            d = loads(raw)
+            height = d["height"]
+            if height < len(net._blocks):
+                if replayed:
+                    # a low height is only legitimate BEFORE the first
+                    # applied record (the snapshot-covered prefix); after
+                    # that it means two blocks were journaled at one
+                    # height — a forked journal, not a replayable one
+                    raise WALError(
+                        f"wal {wal_path}: duplicate record at height "
+                        f"{height} after replay began"
+                    )
+                continue  # prefix already captured by the snapshot
+            if height > len(net._blocks):
+                raise WALError(
+                    f"wal {wal_path}: record at height {height} but ledger "
+                    f"recovered only {len(net._blocks)} blocks (journal gap)"
+                )
+            for key in d["consumed"]:
+                net._state.pop(key, None)
+                net._spent.add(key)
+            net._state.update(d["outputs"])
+            txs = []
+            for tx_id, status, message in d["txs"]:
+                net._status[tx_id] = FinalityEvent(tx_id, TxStatus(status), message)
+                txs.append(tx_id)
+            net._blocks.append(Block(height, txs, d["ts"]))
+            replayed += 1
+        net._wal = wal
+        net._snapshot_path = snap_path
+        if snapshot_every is not None:
+            net.snapshot_every = snapshot_every
+        mx.counter("wal.recoveries").inc()
+        mx.counter("wal.replayed.blocks").inc(replayed)
+        mx.gauge("network.height").set(len(net._blocks))
+        logger.info(
+            "ledger: recovered %d blocks (%d from wal replay) from %s",
+            len(net._blocks), replayed, wal_path,
+        )
         return net

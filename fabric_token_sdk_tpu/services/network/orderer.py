@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator
+from ...utils import faults
 from ...utils import metrics as mx
 
 
@@ -121,6 +122,9 @@ class Orderer:
             return len(self._pending)
 
     def _cut(self) -> List[Submission]:
+        # fault point BEFORE the pop: an injected cut failure strands
+        # nothing — every pending submission survives for the next drive
+        faults.fire("orderer.cut")
         with self._mutex:
             n = min(len(self._pending), max(1, self.policy.max_block_txs))
             return [self._pending.popleft() for _ in range(n)]
@@ -234,6 +238,9 @@ class BlockValidationPipeline:
                 with mx.span(
                     "ledger.block.batch_verify", shape=str(shape), txs=len(rows)
                 ):
+                    # device-plane fault point: firing here exercises the
+                    # degrade-to-host path below (verdicts must not change)
+                    faults.fire("batch.verify")
                     ok = verifier.verify([row for _, _, row in rows])
             except Exception:
                 # the host plane re-verifies these rows; never fail a block

@@ -1,25 +1,77 @@
-"""Multi-process network: a TCP ordering/ledger node + thin client.
+"""Multi-process network: a fault-tolerant TCP ledger node + thin client.
 
 Reference parity: the SDK talks to a Fabric network over gRPC
 (`token/services/network/fabric`); here a JSON-over-TCP node hosts the
 MVCC ledger + validator, and `RemoteNetwork` exposes the same API surface
 as the in-process `Network` so parties can live in separate processes.
+
+Fault tolerance (client side):
+
+* **Pooled persistent connection** with automatic reconnect — one socket
+  per `RemoteNetwork`, re-dialed lazily after any transport failure (a
+  restarted server is picked up transparently).
+* **Retries with exponential backoff + jitter** for the idempotent ops
+  (`status` / `exists` / `resolve` / `height`), counted under
+  `remote.retry.*`.
+* **Exactly-once submit**: a connection that dies with a submit in
+  flight may or may not have committed server-side. The client NEVER
+  resubmits blindly — it consults `status(tx_id)` first and adopts the
+  recorded verdict if one exists (`remote.submit.recovered`); only a
+  tx the ledger has never seen is resubmitted, and the ledger's
+  in-flight dedup is the server half of the guarantee.
+* **Typed remote errors**: a server-side failure arrives as
+  `RemoteError` carrying the server's exception class
+  (`.error_class`), not a blanket "malformed request".
+
+Server side: per-op dispatch errors are logged with traceback and
+returned typed (`remote.dispatch.errors.<op>`); inbound frames are
+capped (`FTS_REMOTE_MAX_FRAME`, default 16 MiB) so a corrupt or hostile
+length prefix can never force an arbitrary-size allocation.
+
+Fault injection: the client fires the `remote.send` / `remote.recv`
+fault points around its frame I/O (`utils/faults.py`), which is how the
+chaos suite proves the retry and exactly-once paths.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator
 from ...models.token import ID
+from ...utils import faults
+from ...utils import metrics as mx
+from ...utils.tracing import logger
 from .ledger import FinalityEvent, Network, TxStatus
 from .orderer import Submission
+
+DEFAULT_MAX_FRAME = 16 * 1024 * 1024  # 16 MiB
+
+
+def _max_frame() -> int:
+    return int(os.environ.get("FTS_REMOTE_MAX_FRAME", str(DEFAULT_MAX_FRAME)))
+
+
+class FrameTooLarge(ValueError):
+    """A length prefix exceeded the frame cap (corrupt or hostile)."""
+
+
+class RemoteError(RuntimeError):
+    """A server-side failure, typed: `error_class` is the exception class
+    name the server hit (e.g. "KeyError"), never a blanket message."""
+
+    def __init__(self, message: str, error_class: Optional[str] = None):
+        super().__init__(message)
+        self.error_class = error_class
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -27,7 +79,7 @@ def _send_msg(sock: socket.socket, obj: dict) -> None:
     sock.sendall(len(raw).to_bytes(4, "big") + raw)
 
 
-def _recv_msg(sock: socket.socket) -> Optional[dict]:
+def _recv_msg(sock: socket.socket, max_frame: Optional[int] = None) -> Optional[dict]:
     hdr = b""
     while len(hdr) < 4:
         chunk = sock.recv(4 - len(hdr))
@@ -35,6 +87,11 @@ def _recv_msg(sock: socket.socket) -> Optional[dict]:
             return None
         hdr += chunk
     n = int.from_bytes(hdr, "big")
+    cap = max_frame if max_frame is not None else _max_frame()
+    if n > cap:
+        # reject BEFORE allocating: a corrupt/hostile prefix must not
+        # drive an arbitrary-size allocation
+        raise FrameTooLarge(f"frame of {n} bytes exceeds cap of {cap}")
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(min(65536, n - len(buf)))
@@ -45,25 +102,68 @@ def _recv_msg(sock: socket.socket) -> Optional[dict]:
 
 
 class LedgerServer:
-    """Hosts a Network (orderer + endorser + committer) over TCP."""
+    """Hosts a Network (orderer + endorser + committer) over TCP.
 
-    def __init__(self, validator: RequestValidator, host: str = "127.0.0.1",
-                 port: int = 0, policy=None):
+    Pass `network=` to serve a pre-built ledger (a `Network.restore` or
+    `Network.recover` result — node-restart parity), or `validator=` to
+    build a fresh one; `wal_path` makes the fresh ledger journaled.
+    `allow_reuse_address` lets a restarted node rebind its old port.
+    """
+
+    def __init__(self, validator: Optional[RequestValidator] = None,
+                 host: str = "127.0.0.1", port: int = 0, policy=None,
+                 network: Optional[Network] = None,
+                 wal_path: Optional[str] = None):
         # concurrent client submits land in the node's ordering queue and
         # group-commit into shared blocks (policy: orderer.BlockPolicy)
-        self.network = Network(validator, policy=policy)
+        if network is None:
+            if validator is None:
+                raise ValueError("LedgerServer needs a validator or a network")
+            network = Network(validator, policy=policy, wal_path=wal_path)
+        self.network = network
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+                try:
+                    self._serve()
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
+
+            def _serve(self):
                 while True:
-                    msg = _recv_msg(self.request)
+                    try:
+                        msg = _recv_msg(self.request)
+                    except FrameTooLarge as e:
+                        mx.counter("remote.frames.rejected").inc()
+                        logger.warning("ledger server: %s", e)
+                        try:
+                            _send_msg(self.request, {
+                                "ok": False, "error": str(e),
+                                "error_class": "FrameTooLarge",
+                            })
+                        except OSError:
+                            pass
+                        return  # stream is desynced: drop the connection
+                    except OSError:
+                        return  # client reset mid-frame
                     if msg is None:
                         return
-                    _send_msg(self.request, outer._dispatch(msg))
+                    try:
+                        _send_msg(self.request, outer._dispatch(msg))
+                    except OSError:
+                        return  # client went away before the response
 
-        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
-        self._server.daemon_threads = True
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # restarted nodes rebind their port
+            daemon_threads = True
+
+        self._server = _Server((host, port), Handler)
         self.address: Tuple[str, int] = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
 
@@ -74,10 +174,25 @@ class LedgerServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # sever live client connections too: a stopped node must not keep
+        # answering from daemon handler threads (clients should observe
+        # the death and reconnect to the restarted node)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _dispatch(self, msg: dict) -> dict:
+        op = msg.get("op", "?") if isinstance(msg, dict) else "?"
         try:
-            op = msg["op"]
             if op == "submit":
                 ev = self.network.submit(bytes.fromhex(msg["request"]))
                 return {"ok": True, "status": ev.status.value, "message": ev.message,
@@ -94,11 +209,17 @@ class LedgerServer:
                 return {"ok": True, "status": ev.status.value, "message": ev.message}
             if op == "height":
                 return {"ok": True, "height": self.network.height()}
-            return {"ok": False, "error": f"unknown op [{op}]"}
+            return {"ok": False, "error": f"unknown op [{op}]",
+                    "error_class": "UnknownOp"}
         except ValidationError as e:
             return {"ok": False, "validation_error": str(e)}
-        except Exception:  # defensive: never kill the server loop
-            return {"ok": False, "error": "malformed request"}
+        except Exception as e:  # defensive: never kill the server loop —
+            # but never mask the failure either: log the traceback
+            # server-side and hand the client the typed exception
+            mx.counter(f"remote.dispatch.errors.{op}").inc()
+            logger.exception("ledger server: op %s failed", op)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}",
+                    "error_class": type(e).__name__}
 
 
 class RemoteNetwork:
@@ -108,33 +229,143 @@ class RemoteNetwork:
     so each party process drives its own vault via `apply_finality`.
     """
 
-    def __init__(self, address: Tuple[str, int]):
+    def __init__(self, address: Tuple[str, int],
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
         self.address = tuple(address)
+        self.timeout = (
+            float(os.environ.get("FTS_REMOTE_TIMEOUT_S", "30"))
+            if timeout is None else timeout
+        )
+        self.retries = (
+            int(os.environ.get("FTS_REMOTE_RETRIES", "4"))
+            if retries is None else retries
+        )
+        self.backoff_s = (
+            float(os.environ.get("FTS_REMOTE_BACKOFF_S", "0.05"))
+            if backoff_s is None else backoff_s
+        )
         self._listeners: List[Callable] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards the pooled socket
+        self._sock: Optional[socket.socket] = None
+        self._rng = random.Random()  # backoff jitter (decorrelates clients)
+
+    # ------------------------------------------------------- transport
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _connect_locked(self) -> None:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.address, timeout=self.timeout)
+            mx.counter("remote.connects").inc()
 
     def _call(self, msg: dict) -> dict:
-        with socket.create_connection(self.address, timeout=30) as sock:
-            _send_msg(sock, msg)
-            resp = _recv_msg(sock)
-        if resp is None:
-            raise ConnectionError("ledger server closed the connection")
+        """One request/response over the pooled connection. Any transport
+        failure closes the socket (the next call re-dials) and raises
+        ConnectionError/OSError; server-side failures raise typed
+        ValidationError/RemoteError and keep the connection."""
+        with self._lock:
+            self._connect_locked()
+            try:
+                faults.fire("remote.send")
+                _send_msg(self._sock, msg)
+                faults.fire("remote.recv")
+                resp = _recv_msg(self._sock)
+            except (OSError, FrameTooLarge):
+                # FaultConnectionDrop is a ConnectionError, hence OSError
+                self._close_locked()
+                raise
+            if resp is None:
+                self._close_locked()
+                raise ConnectionError("ledger server closed the connection")
         if not resp.get("ok"):
             if "validation_error" in resp:
                 raise ValidationError(resp["validation_error"])
-            raise RuntimeError(resp.get("error", "remote error"))
+            raise RemoteError(resp.get("error", "remote error"),
+                              error_class=resp.get("error_class"))
         return resp
+
+    def _backoff(self, attempt: int) -> None:
+        delay = self.backoff_s * (2 ** attempt) * (0.5 + self._rng.random())
+        time.sleep(min(delay, 2.0))
+
+    def _call_idempotent(self, msg: dict) -> dict:
+        """Retry transport failures with exponential backoff + jitter —
+        ONLY safe for ops that do not mutate ledger state."""
+        op = msg.get("op")
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._call(msg)
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt < self.retries:
+                    mx.counter(f"remote.retry.{op}").inc()
+                    mx.counter("remote.retry.attempts").inc()
+                    self._backoff(attempt)
+        mx.counter("remote.retry.exhausted").inc()
+        raise ConnectionError(
+            f"remote {op} failed after {self.retries + 1} attempts: {last}"
+        ) from last
+
+    # ------------------------------------------------------- Network API
 
     def subscribe(self, listener) -> None:
         self._listeners.append(listener)
 
     def submit(self, request_bytes: bytes) -> FinalityEvent:
-        resp = self._call({"op": "submit", "request": request_bytes.hex()})
-        event = FinalityEvent(resp["tx_id"], TxStatus(resp["status"]), resp["message"])
         request = TokenRequest.from_bytes(request_bytes)
-        for listener in self._listeners:
-            listener(event, request)
+        event = self._submit_exactly_once(request.anchor, request_bytes)
+        self._notify(event, request)
         return event
+
+    def _submit_exactly_once(self, tx_id: str, request_bytes: bytes) -> FinalityEvent:
+        """Submit with at-most-once commit semantics across retries: on a
+        dropped connection, consult `status(tx_id)` BEFORE resubmitting —
+        the commit may have raced the disconnect. The ledger's in-flight
+        dedup covers the residual window where status is still empty."""
+        msg = {"op": "submit", "request": request_bytes.hex()}
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                resp = self._call(msg)
+                return FinalityEvent(
+                    resp["tx_id"], TxStatus(resp["status"]), resp["message"]
+                )
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt >= self.retries:
+                    break
+                # counted only when actually retried (same accounting as
+                # _call_idempotent)
+                mx.counter("remote.retry.submit").inc()
+                mx.counter("remote.retry.attempts").inc()
+                self._backoff(attempt)
+                try:
+                    known = self.status(tx_id)
+                except (ConnectionError, OSError) as e2:
+                    last = e2
+                    continue
+                if known is not None:
+                    mx.counter("remote.submit.recovered").inc()
+                    return known
+                # the ledger has never recorded this tx: resubmitting is
+                # safe (and dedup'd server-side regardless)
+        mx.counter("remote.retry.exhausted").inc()
+        raise ConnectionError(
+            f"submit of {tx_id} failed after {self.retries + 1} attempts: {last}"
+        ) from last
 
     def submit_async(self, request_bytes: bytes) -> Submission:
         """API parity with the in-process `Network`: the wire protocol is
@@ -147,22 +378,24 @@ class RemoteNetwork:
         return sub
 
     def resolve_input(self, token_id: ID) -> bytes:
-        resp = self._call({"op": "resolve", "tx_id": token_id.tx_id, "index": token_id.index})
+        resp = self._call_idempotent(
+            {"op": "resolve", "tx_id": token_id.tx_id, "index": token_id.index}
+        )
         return bytes.fromhex(resp["output"])
 
     def exists(self, token_id: ID) -> bool:
-        return self._call(
+        return self._call_idempotent(
             {"op": "exists", "tx_id": token_id.tx_id, "index": token_id.index}
         )["exists"]
 
     def status(self, tx_id: str) -> Optional[FinalityEvent]:
-        resp = self._call({"op": "status", "tx_id": tx_id})
+        resp = self._call_idempotent({"op": "status", "tx_id": tx_id})
         if resp["status"] is None:
             return None
         return FinalityEvent(tx_id, TxStatus(resp["status"]), resp.get("message", ""))
 
     def height(self) -> int:
-        return self._call({"op": "height"})["height"]
+        return self._call_idempotent({"op": "height"})["height"]
 
     def apply_finality(self, request_bytes: bytes) -> Optional[FinalityEvent]:
         """Receiver-side sync: given a request distributed off-band (the
@@ -171,6 +404,18 @@ class RemoteNetwork:
         request = TokenRequest.from_bytes(request_bytes)
         event = self.status(request.anchor)
         if event is not None:
-            for listener in self._listeners:
-                listener(event, request)
+            self._notify(event, request)
         return event
+
+    def _notify(self, event: FinalityEvent, request: TokenRequest) -> None:
+        """Per-listener crash isolation, mirroring the in-process ledger:
+        a throwing finality listener is counted and logged, and the
+        remaining listeners still run."""
+        for listener in self._listeners:
+            try:
+                listener(event, request)
+            except Exception:
+                mx.counter("remote.listener.errors").inc()
+                logger.exception(
+                    "remote: finality listener failed for tx %s", event.tx_id
+                )

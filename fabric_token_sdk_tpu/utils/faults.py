@@ -1,0 +1,152 @@
+"""Fault injection: named fault points armed via env or API.
+
+The durability/recovery guarantees of the ledger (WAL + snapshot
+recovery, exactly-once remote submit) are only credible if they are
+exercised under injected faults — this module is the lever the chaos
+suite (`tests/test_recovery.py`) pulls. Production code sprinkles
+zero-cost `faults.fire("<site>")` calls at the crash-interesting
+boundaries; nothing happens unless a fault is armed for that site.
+
+Registered sites (grep for `faults.fire` to confirm the live set):
+
+    wal.append           before a WAL record is written + fsync'd
+    ledger.commit_block  before the block's WAL append / atomic merge
+    orderer.cut          before a block is cut from the ordering queue
+    remote.send          client-side, before a request frame is sent
+    remote.recv          client-side, before a response frame is read
+    batch.verify         inside the device-plane block verify (degrades
+                         to host validation, never fails the block)
+
+Arming:
+
+* Env: ``FTS_FAULTS="site:kind:prob[:count[:delay_s]]"``, comma-separated
+  for multiple sites; parsed once at import and re-parseable via
+  ``load_env()`` (tests set the env then call it). Example:
+  ``FTS_FAULTS="remote.recv:drop:1.0:1"`` drops the client connection
+  exactly once, with probability 1.
+* Programmatic: ``faults.arm("wal.append", "error", prob=0.5, count=3)``.
+
+Kinds: ``error`` raises ``FaultInjected``; ``drop`` raises
+``FaultConnectionDrop`` (a ``ConnectionError``, so transport-level retry
+paths treat it exactly like a real dead socket); ``delay`` sleeps
+``delay_s`` then returns. Every firing increments the
+``faults.injected.<site>`` counter, so a chaos run's sidecar records
+exactly what was injected where.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from . import metrics as mx
+
+
+class FaultInjected(RuntimeError):
+    """An armed `error`-kind fault point fired."""
+
+
+class FaultConnectionDrop(ConnectionError):
+    """An armed `drop`-kind fault point fired (transport-shaped)."""
+
+
+_KINDS = ("error", "drop", "delay")
+
+
+@dataclass
+class _Armed:
+    site: str
+    kind: str
+    prob: float = 1.0
+    remaining: Optional[int] = None  # None = unlimited firings
+    delay_s: float = 0.05
+    exc: Optional[BaseException] = None  # overrides the default exception
+
+
+_armed: Dict[str, _Armed] = {}
+_lock = threading.Lock()
+# deterministic by default so prob<1 chaos runs are reproducible
+_rng = random.Random(int(os.environ.get("FTS_FAULTS_SEED", "0xF75"), 0))
+
+
+def arm(site: str, kind: str = "error", prob: float = 1.0,
+        count: Optional[int] = None, delay_s: float = 0.05,
+        exc: Optional[BaseException] = None) -> None:
+    """Arm `site` to fire `count` times (None = forever) with `prob`."""
+    if kind not in _KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} (want one of {_KINDS})")
+    with _lock:
+        _armed[site] = _Armed(site, kind, prob, count, delay_s, exc)
+
+
+def disarm(site: str) -> None:
+    with _lock:
+        _armed.pop(site, None)
+
+
+def clear() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def armed() -> Dict[str, str]:
+    """Snapshot of armed sites -> kind (for logs/tests)."""
+    with _lock:
+        return {s: f.kind for s, f in _armed.items()}
+
+
+def fire(site: str) -> None:
+    """The fault point: no-op unless `site` is armed (the disarmed fast
+    path is one dict lookup on an almost-always-empty dict)."""
+    if not _armed:
+        return
+    with _lock:
+        f = _armed.get(site)
+        if f is None:
+            return
+        if f.remaining is not None and f.remaining <= 0:
+            return
+        if f.prob < 1.0 and _rng.random() >= f.prob:
+            return
+        if f.remaining is not None:
+            f.remaining -= 1
+        kind, delay_s, exc = f.kind, f.delay_s, f.exc
+    mx.counter(f"faults.injected.{site}").inc()
+    if kind == "delay":
+        time.sleep(delay_s)
+        return
+    if exc is not None:
+        raise exc
+    if kind == "drop":
+        raise FaultConnectionDrop(f"injected connection drop at {site}")
+    raise FaultInjected(f"injected fault at {site}")
+
+
+def load_env(spec: Optional[str] = None) -> int:
+    """Parse ``FTS_FAULTS="site:kind:prob[:count[:delay_s]],..."`` and arm
+    every entry; returns how many were armed. A malformed entry raises
+    (arming faults wrong should be loud, not silent)."""
+    if spec is None:
+        spec = os.environ.get("FTS_FAULTS", "")
+    n = 0
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"bad FTS_FAULTS entry {part!r}")
+        site, kind = fields[0], fields[1]
+        prob = float(fields[2]) if len(fields) > 2 else 1.0
+        count = int(fields[3]) if len(fields) > 3 else None
+        delay_s = float(fields[4]) if len(fields) > 4 else 0.05
+        arm(site, kind, prob=prob, count=count, delay_s=delay_s)
+        n += 1
+    return n
+
+
+load_env()

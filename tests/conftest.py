@@ -55,3 +55,14 @@ def fts_warmup_session():
 @pytest.fixture
 def rng():
     return random.Random(0xF75)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault armed in one test may leak into the next (the fault
+    registry is process-global by design — see utils/faults.py)."""
+    yield
+    from fabric_token_sdk_tpu.utils import faults
+
+    if faults.armed():
+        faults.clear()

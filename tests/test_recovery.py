@@ -1,0 +1,741 @@
+"""Chaos suite: WAL durability, crash recovery, fault-hardened remote path.
+
+Proves the PR-7 invariants under injected faults (`utils/faults.py`):
+
+* the WAL journal survives torn tails and CRC corruption (truncate, never
+  crash);
+* `Network.recover` rebuilds snapshot + WAL-suffix state exactly — a
+  block a submitter ever saw finality for is never lost, a double spend
+  is never accepted post-recovery (including after a real SIGKILL of a
+  `LedgerServer` subprocess, marked slow+chaos);
+* a WAL append that lands before a crash is REDOne on recovery even
+  though the in-memory merge never happened;
+* `RemoteNetwork` retries idempotent ops through connection drops and
+  submits exactly once across a drop that races the server-side commit
+  (the client consults `status()` before resubmitting);
+* an injected device-plane fault during block validation degrades to
+  host validation with identical verdicts;
+* dispatch failures arrive typed (server exception class, not "malformed
+  request"), oversized frames are rejected before allocation, and remote
+  finality listeners get per-listener crash isolation.
+"""
+import os
+import random
+import select
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+from fabric_token_sdk_tpu.models.token import ID
+from fabric_token_sdk_tpu.services.network import (
+    BlockPolicy, Network, TxStatus, WALError, WriteAheadLog,
+)
+from fabric_token_sdk_tpu.services.network.remote import (
+    FrameTooLarge, LedgerServer, RemoteError, RemoteNetwork, _recv_msg,
+)
+from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return mx.REGISTRY.counter(name).value
+
+
+@pytest.fixture(scope="module")
+def zk_pp():
+    return setup(base=4, exponent=2, rng=random.Random(0xF75))
+
+
+def build_env(driver_factory, network):
+    """issuer + alice + bob bound to `network` (in-process or remote)."""
+    parties = {
+        name: Party(name, driver_factory(), network)
+        for name in ("issuer-node", "alice-node", "bob-node")
+    }
+    issuer = parties["issuer-node"].new_issuer_wallet("issuer")
+    alice = parties["alice-node"].new_owner_wallet("alice", anonymous=False)
+    bob = parties["bob-node"].new_owner_wallet("bob", anonymous=False)
+    validator = getattr(network, "validator", None)  # in-process only
+    if validator is not None and hasattr(getattr(validator.driver, "pp", None),
+                                         "add_issuer"):
+        validator.driver.pp.add_issuer(issuer.identity)
+    return parties, issuer, alice, bob
+
+
+def fab_net(wal_path=None, policy=None, snapshot_every=0):
+    pp = FabTokenPublicParams()
+    net = Network(
+        RequestValidator(FabTokenDriver(pp)), policy=policy,
+        wal_path=wal_path, snapshot_every=snapshot_every,
+    )
+    return pp, net
+
+
+def issue_to(parties, alice, values, anchor):
+    tx = Transaction(parties["issuer-node"], anchor)
+    tx.issue(
+        "issuer", "USD", list(values),
+        [alice.recipient_identity()] * len(values), anonymous=False,
+    )
+    tx.collect_endorsements(None)
+    tx.submit()
+    return tx
+
+
+def manual_transfer(party, token_id, value, recipient, anchor):
+    """Assemble + sign a transfer spending ONE specific token, bypassing
+    the selector (whose locks would forbid crafting a double spend)."""
+    req = party.tms.new_request(anchor)
+    tokens, metas = party.vault.get_many([token_id])
+    party.tms.add_transfer(req, [token_id], tokens, metas, "USD", [value], [recipient])
+    party.tms.sign_transfers(req)
+    return req
+
+
+# ===================================================================
+# WAL journal unit behavior
+# ===================================================================
+
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    wal = WriteAheadLog(tmp_path / "t.wal")
+    payloads = [b"alpha", b"", b"\x00" * 1000, b"tail"]
+    for p in payloads:
+        wal.append(p)
+    assert wal.replay() == payloads
+    # replay is non-destructive for intact journals, and append continues
+    wal.append(b"more")
+    assert wal.replay() == payloads + [b"more"]
+    wal.close()
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    path = tmp_path / "t.wal"
+    wal = WriteAheadLog(path)
+    wal.append(b"one")
+    wal.append(b"two")
+    before = _counter("wal.torn_tails")
+    # a partial record: valid-looking header promising more than exists
+    with open(path, "ab") as fh:
+        fh.write(struct.pack(">II", 4096, 0xDEAD) + b"only-a-fragment")
+    assert wal.replay() == [b"one", b"two"]
+    assert _counter("wal.torn_tails") - before == 1
+    # the tail was truncated: the journal is clean again and appendable
+    wal.append(b"three")
+    assert wal.replay() == [b"one", b"two", b"three"]
+    assert _counter("wal.torn_tails") - before == 1
+    wal.close()
+
+
+def test_wal_crc_corruption_is_a_torn_tail(tmp_path):
+    path = tmp_path / "t.wal"
+    wal = WriteAheadLog(path)
+    wal.append(b"good-record")
+    wal.append(b"bad-record!")
+    with open(path, "r+b") as fh:  # flip one payload byte of the LAST record
+        fh.seek(-1, os.SEEK_END)
+        last = fh.read(1)
+        fh.seek(-1, os.SEEK_END)
+        fh.write(bytes([last[0] ^ 0xFF]))
+    before = _counter("wal.torn_tails")
+    assert wal.replay() == [b"good-record"]
+    assert _counter("wal.torn_tails") - before == 1
+    wal.close()
+
+
+# ===================================================================
+# Ledger durability: recover from WAL + snapshot compaction
+# ===================================================================
+
+
+def _seed_and_pay(net, pp, n_tokens=3):
+    """Seed block + (n_tokens - 1) transfer blocks, plus a correctly
+    signed conflicting spend of the first token (crafted from live vault
+    state BEFORE its input is consumed) for post-recovery MVCC checks."""
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net)
+    issue_to(parties, alice, [5] * n_tokens, "seed")
+    alice_p = parties["alice-node"]
+    ids = alice_p.vault.token_ids()
+    dup = manual_transfer(alice_p, ids[0], 5, bob.recipient_identity(), "dup")
+    for i, tid in enumerate(ids[: n_tokens - 1]):
+        req = manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"pay-{i}")
+        ev = net.submit(req.to_bytes())
+        assert ev.status == TxStatus.VALID
+    return parties, alice, bob, ids, dup
+
+
+def test_network_recover_replays_wal(tmp_path):
+    wal_path = str(tmp_path / "ledger.wal")
+    pp, net = fab_net(wal_path=wal_path)
+    _, _, _, ids, dup = _seed_and_pay(net, pp)
+
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.height() == net.height() == 3
+    for anchor in ("seed", "pay-0", "pay-1"):
+        assert net2.status(anchor).status == TxStatus.VALID
+    assert net2.block(1).txs == ["pay-0"]
+    # state identical: spent inputs gone, outputs resolvable
+    assert not net2.exists(ID("seed", 0))
+    assert net2.resolve_input(ID("pay-0", 0)) == net.resolve_input(ID("pay-0", 0))
+    assert net2.exists(ID("seed", 2)) and net.exists(ID("seed", 2))
+    # and the recovered ledger still enforces MVCC: a correctly-signed
+    # double spend of the recovered-spent seed.0 is rejected
+    ev = net2.submit(dup.to_bytes())
+    assert ev.status == TxStatus.INVALID
+    assert "already spent" in ev.message
+
+
+def test_snapshot_compaction_truncates_replayed_prefix(tmp_path):
+    wal_path = str(tmp_path / "ledger.wal")
+    pp = FabTokenPublicParams()
+    before_snaps = _counter("wal.snapshots")
+    net = Network(
+        RequestValidator(FabTokenDriver(pp)), wal_path=wal_path, snapshot_every=2
+    )
+    _seed_and_pay(net, pp, n_tokens=4)  # 4 blocks: seed + pay-0..2
+    assert _counter("wal.snapshots") - before_snaps == 2  # at heights 2, 4
+    assert os.path.exists(wal_path + ".snap")
+    # compaction truncated the journal: only the un-snapshotted suffix is
+    # replayed (here: nothing — height 4 snapshot covers everything)
+    assert WriteAheadLog(wal_path).replay() == []
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.height() == 4
+    assert net2.status("pay-2").status == TxStatus.VALID
+    # post-recovery commits keep journaling + compacting on the same files
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net2)
+    issue_to(parties, alice, [1], "post")
+    assert net2.height() == 5
+    net3 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net3.height() == 5 and net3.status("post").status == TxStatus.VALID
+
+
+def test_crash_between_wal_append_and_merge_redoes_block(tmp_path, monkeypatch):
+    """The WAL-before-merge ordering: a block whose record is fsync'd but
+    whose in-memory merge crashed is REDOne on recovery. The submitter
+    never saw finality — it re-learns the verdict via status()."""
+    wal_path = str(tmp_path / "ledger.wal")
+    pp, net = fab_net(wal_path=wal_path)
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net)
+    issue_to(parties, alice, [5], "seed")
+    alice_p = parties["alice-node"]
+    tid = alice_p.vault.token_ids()[0]
+    req = manual_transfer(alice_p, tid, 5, bob.recipient_identity(), "pay")
+
+    from fabric_token_sdk_tpu.services.network import ledger as ledger_mod
+
+    def crash(self):
+        raise OSError("simulated crash between WAL append and merge")
+
+    monkeypatch.setattr(ledger_mod._BlockView, "merge", crash)
+    with pytest.raises(OSError):
+        net.submit(req.to_bytes())
+    assert net.status("pay") is None  # crashed node never applied it
+    monkeypatch.undo()
+
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.status("pay").status == TxStatus.VALID  # redo from journal
+    assert net2.exists(ID("pay", 0)) and not net2.exists(ID("seed", 0))
+    # and replaying the identical submission is the idempotent no-op
+    assert net2.submit(req.to_bytes()).status == TxStatus.VALID
+    assert net2.height() == 2
+
+
+def test_injected_wal_fault_fails_commit_without_finality(tmp_path):
+    """An injected `wal.append` fault loses the block BEFORE anything was
+    promised: the submitter gets an error, nothing durable is recorded,
+    and an identical resubmission succeeds once the fault clears."""
+    wal_path = str(tmp_path / "ledger.wal")
+    pp, net = fab_net(wal_path=wal_path)
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net)
+    faults.arm("wal.append", "error", count=1)
+    before = _counter("faults.injected.wal.append")
+    with pytest.raises(faults.FaultInjected):
+        issue_to(parties, alice, [5], "seed")
+    assert _counter("faults.injected.wal.append") - before == 1
+    assert net.status("seed") is None and net.height() == 0
+    issue_to(parties, alice, [5], "seed")  # fault expended: succeeds
+    assert net.status("seed").status == TxStatus.VALID
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.height() == 1 and net2.status("seed").status == TxStatus.VALID
+
+
+def test_failed_wal_append_rolls_back_journal(tmp_path, monkeypatch):
+    """An append that fails AFTER its bytes hit the file (fsync ENOSPC)
+    must roll the journal back to the pre-append boundary — otherwise the
+    aborted block's record survives, the next commit journals the same
+    height again, and recovery resurrects the wrong block."""
+    wal_path = str(tmp_path / "ledger.wal")
+    pp, net = fab_net(wal_path=wal_path)
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net)
+    issue_to(parties, alice, [5], "seed")
+    alice_p = parties["alice-node"]
+    tid = alice_p.vault.token_ids()[0]
+    req = manual_transfer(alice_p, tid, 5, bob.recipient_identity(), "pay")
+
+    from fabric_token_sdk_tpu.services.network import wal as wal_mod
+
+    def flaky_fsync(fd):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(wal_mod.os, "fsync", flaky_fsync)
+    before = _counter("wal.append_failures")
+    with pytest.raises(OSError):
+        net.submit(req.to_bytes())
+    monkeypatch.undo()
+    assert _counter("wal.append_failures") - before == 1
+    assert not net._wal.poisoned  # rollback succeeded: journal is clean
+    assert len(WriteAheadLog(wal_path).replay()) == 1  # the record is GONE
+    # the retried commit journals at the correct height; recovery agrees
+    assert net.submit(req.to_bytes()).status == TxStatus.VALID
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.height() == 2
+    assert net2.status("pay").status == TxStatus.VALID
+
+
+def test_recover_rejects_forked_journal(tmp_path):
+    """Two records journaled at ONE height (the hole the append rollback
+    closes) must fail recovery loudly, never resurrect a forked ledger."""
+    from fabric_token_sdk_tpu.crypto.serialization import dumps
+
+    wal_path = str(tmp_path / "forked.wal")
+    wal = WriteAheadLog(wal_path)
+    rec = {"height": 0, "ts": 0.0, "requests": [],
+           "txs": [["a", "Valid", ""]], "consumed": [], "outputs": {}}
+    wal.append(dumps(rec))
+    wal.append(dumps(rec))  # second block at the SAME height
+    wal.close()
+    with pytest.raises(WALError):
+        Network.recover(
+            RequestValidator(FabTokenDriver(FabTokenPublicParams())), wal_path
+        )
+
+
+def test_snapshot_failure_does_not_poison_commit(tmp_path, monkeypatch):
+    """Compaction runs after the block is durably journaled: a snapshot
+    failure is counted and logged, but the commit acknowledgement,
+    listeners, and a later recovery are untouched."""
+    wal_path = str(tmp_path / "ledger.wal")
+    pp = FabTokenPublicParams()
+    net = Network(
+        RequestValidator(FabTokenDriver(pp)), wal_path=wal_path, snapshot_every=1
+    )
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), net)
+
+    def broken_compact(self):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(Network, "_compact", broken_compact)
+    before = _counter("wal.snapshot_failures")
+    issue_to(parties, alice, [5], "seed")  # must commit despite the failure
+    assert _counter("wal.snapshot_failures") - before == 1
+    assert net.status("seed").status == TxStatus.VALID
+    assert parties["alice-node"].balance("USD") == 5  # listeners ran
+    monkeypatch.undo()
+    net2 = Network.recover(RequestValidator(FabTokenDriver(pp)), wal_path)
+    assert net2.height() == 1
+    assert net2.status("seed").status == TxStatus.VALID
+
+
+# ===================================================================
+# Fault-injection framework
+# ===================================================================
+
+
+def test_faults_env_parse_count_and_kinds():
+    before = _counter("faults.injected.t.site")
+    assert faults.load_env("t.site:error:1.0:2") == 1
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("t.site")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("t.site")
+    faults.fire("t.site")  # count expended: no-op
+    assert _counter("faults.injected.t.site") - before == 2
+
+    faults.arm("t.drop", "drop")
+    with pytest.raises(ConnectionError):  # drop is transport-shaped
+        faults.fire("t.drop")
+    faults.arm("t.delay", "delay", delay_s=0.05)
+    t0 = time.monotonic()
+    faults.fire("t.delay")
+    assert time.monotonic() - t0 >= 0.04
+    faults.arm("t.never", "error", prob=0.0)
+    faults.fire("t.never")  # prob 0 never fires
+    assert "t.delay" in faults.armed()
+    faults.clear()
+    assert faults.armed() == {}
+    faults.fire("t.site")  # disarmed: plain no-op
+    with pytest.raises(ValueError):
+        faults.load_env("missing-kind")
+    with pytest.raises(ValueError):
+        faults.arm("x", "explode")
+
+
+# ===================================================================
+# Remote path under faults
+# ===================================================================
+
+
+def _remote_env(policy=None, wal_path=None):
+    pp = FabTokenPublicParams()
+    server = LedgerServer(
+        RequestValidator(FabTokenDriver(pp)), policy=policy, wal_path=wal_path
+    ).start()
+    client = RemoteNetwork(server.address, timeout=10, backoff_s=0.01)
+    return pp, server, client
+
+
+def test_remote_retry_through_connection_drops():
+    pp, server, client = _remote_env()
+    try:
+        faults.arm("remote.send", "drop", count=2)
+        before = _counter("remote.retry.attempts")
+        assert client.height() == 0  # succeeds through 2 dropped attempts
+        assert _counter("remote.retry.attempts") - before == 2
+        # exhausted retries surface as a clean ConnectionError
+        faults.arm("remote.send", "drop")  # unlimited
+        ex_before = _counter("remote.retry.exhausted")
+        with pytest.raises(ConnectionError):
+            client.height()
+        assert _counter("remote.retry.exhausted") - ex_before == 1
+    finally:
+        faults.clear()
+        server.stop()
+
+
+def _one_issue(pp, client, anchor, value=9):
+    parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), client)
+    tx = Transaction(parties["issuer-node"], anchor)
+    tx.issue("issuer", "USD", [value], [alice.recipient_identity()],
+             anonymous=False)
+    tx.collect_endorsements(None)
+    return parties, tx
+
+
+def test_remote_submit_exactly_once_across_recv_drop():
+    """Acceptance: the connection drops after the server commits but
+    before the client reads the response; the client recovers the verdict
+    via status() and the tx commits EXACTLY once — block count and vault
+    balance agree with a no-fault run."""
+    # no-fault run: the expected deltas
+    pp0, server0, client0 = _remote_env()
+    try:
+        blocks_before = _counter("ledger.blocks.committed")
+        parties0, tx0 = _one_issue(pp0, client0, "mint")
+        ev = client0.submit(tx0.request.to_bytes())
+        assert ev.status == TxStatus.VALID
+        expected_blocks = _counter("ledger.blocks.committed") - blocks_before
+        expected_balance = parties0["alice-node"].balance("USD")
+    finally:
+        server0.stop()
+    assert expected_blocks == 1 and expected_balance == 9
+
+    # fault run: FTS_FAULTS drops the client connection on the response.
+    # The wider backoff gives the server-side commit (already in flight
+    # when the drop fires) time to finish before the status consult.
+    pp1 = FabTokenPublicParams()
+    server1 = LedgerServer(RequestValidator(FabTokenDriver(pp1))).start()
+    client1 = RemoteNetwork(server1.address, timeout=10, backoff_s=0.1)
+    try:
+        parties1, tx1 = _one_issue(pp1, client1, "mint")
+        blocks_before = _counter("ledger.blocks.committed")
+        recovered_before = _counter("remote.submit.recovered")
+        assert faults.load_env("remote.recv:drop:1.0:1") == 1
+        ev = client1.submit(tx1.request.to_bytes())
+        assert ev.status == TxStatus.VALID and ev.tx_id == "mint"
+        # exactly once: same block delta, same balance as the no-fault run
+        assert _counter("ledger.blocks.committed") - blocks_before == expected_blocks
+        assert parties1["alice-node"].balance("USD") == expected_balance
+        assert _counter("remote.submit.recovered") - recovered_before == 1
+        assert client1.status("mint").status == TxStatus.VALID
+    finally:
+        faults.clear()
+        server1.stop()
+
+
+def test_remote_dispatch_errors_are_typed():
+    pp, server, client = _remote_env()
+    try:
+        before = _counter("remote.dispatch.errors.resolve")
+        with pytest.raises(RemoteError) as ei:
+            client._call({"op": "resolve", "tx_id": "x"})  # missing "index"
+        assert ei.value.error_class == "KeyError"
+        assert "index" in str(ei.value)
+        assert _counter("remote.dispatch.errors.resolve") - before == 1
+        # unknown op is typed too, and the connection survives both
+        with pytest.raises(RemoteError) as ei:
+            client._call({"op": "frobnicate"})
+        assert ei.value.error_class == "UnknownOp"
+        assert client.height() == 0
+    finally:
+        server.stop()
+
+
+def test_remote_frame_cap_client_and_server():
+    # client side: a hostile length prefix is rejected before allocation
+    a, b = socket.socketpair()
+    try:
+        a.sendall((99 * 1024 * 1024).to_bytes(4, "big"))
+        with pytest.raises(FrameTooLarge):
+            _recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+    # server side: typed error response, then the connection is dropped
+    pp, server, client = _remote_env()
+    try:
+        before = _counter("remote.frames.rejected")
+        s = socket.create_connection(server.address, timeout=10)
+        s.sendall((64 * 1024 * 1024).to_bytes(4, "big") + b"xx")
+        resp = _recv_msg(s)
+        assert resp == {
+            "ok": False,
+            "error": "frame of 67108864 bytes exceeds cap of 16777216",
+            "error_class": "FrameTooLarge",
+        }
+        assert s.recv(1) == b""  # server closed the desynced stream
+        s.close()
+        assert _counter("remote.frames.rejected") - before == 1
+        assert client.height() == 0  # server loop unharmed
+    finally:
+        server.stop()
+
+
+def test_remote_listener_crash_isolation():
+    pp, server, client = _remote_env()
+    try:
+        seen = []
+
+        def boom(event, request):
+            raise RuntimeError("listener crashed")
+
+        client.subscribe(boom)
+        client.subscribe(lambda e, r: seen.append(e.tx_id))
+        before = _counter("remote.listener.errors")
+        parties, tx = _one_issue(pp, client, "mint")
+        ev = client.submit(tx.request.to_bytes())
+        assert ev.status == TxStatus.VALID
+        assert _counter("remote.listener.errors") - before == 1
+        assert "mint" in seen  # listeners AFTER the crasher still ran
+        # apply_finality mirrors the same isolation
+        assert client.apply_finality(tx.request.to_bytes()).status == TxStatus.VALID
+        assert _counter("remote.listener.errors") - before == 2
+    finally:
+        server.stop()
+
+
+def test_remote_snapshot_restore_server_restart():
+    """Satellite: stop a LedgerServer, restore its Network from the
+    snapshot on the SAME port — the pooled client reconnects by itself
+    and sees identical height/status/exists answers."""
+    pp, server, client = _remote_env()
+    port = server.address[1]
+    try:
+        parties, tx = _one_issue(pp, client, "mint", value=7)
+        client.submit(tx.request.to_bytes())
+        height = client.height()
+        assert height == 1 and client.exists(ID("mint", 0))
+
+        snap = server.network.snapshot()
+        server.stop()
+        server = LedgerServer(
+            network=Network.restore(RequestValidator(FabTokenDriver(pp)), snap),
+            port=port,
+        ).start()
+        # same client instance: its pooled socket is dead, the retry path
+        # re-dials transparently
+        connects_before = _counter("remote.connects")
+        assert client.height() == height
+        assert _counter("remote.connects") - connects_before >= 1
+        assert client.status("mint").status == TxStatus.VALID
+        assert client.exists(ID("mint", 0))
+        assert client.resolve_input(ID("mint", 0)) == server.network.resolve_input(
+            ID("mint", 0)
+        )
+    finally:
+        server.stop()
+
+
+# ===================================================================
+# Device-plane fault during block validation: degrade, don't diverge
+# ===================================================================
+
+
+def test_batch_verify_fault_degrades_to_host_same_verdicts(zk_pp):
+    """An injected `batch.verify` fault mid-block falls back to host
+    validation with IDENTICAL verdicts (batching may only accelerate,
+    never change, accept/reject)."""
+
+    def run(inject):
+        net = Network(
+            RequestValidator(ZKATDLogDriver(zk_pp)),
+            policy=BlockPolicy(max_block_txs=8, min_batch=2),
+        )
+        parties, issuer, alice, bob = build_env(lambda: ZKATDLogDriver(zk_pp), net)
+        issue_to(parties, alice, [5, 5], "seed")
+        alice_p = parties["alice-node"]
+        reqs = [
+            manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"pay-{i}")
+            for i, tid in enumerate(alice_p.vault.token_ids())
+        ]
+        if inject:
+            faults.arm("batch.verify", "error", count=1)
+        try:
+            events = net.submit_many([r.to_bytes() for r in reqs])
+        finally:
+            faults.clear()
+        return [e.status for e in events], parties["bob-node"].balance("USD")
+
+    host_before = _counter("ledger.validate.host")
+    errors_before = _counter("ledger.block.batch_errors")
+    injected = run(inject=True)
+    assert _counter("ledger.block.batch_errors") - errors_before == 1
+    assert _counter("ledger.validate.host") - host_before == 2  # host fallback
+    clean = run(inject=False)
+    assert injected == clean == ([TxStatus.VALID, TxStatus.VALID], 10)
+
+
+# ===================================================================
+# The real thing: SIGKILL a ledger node mid-workload, recover from WAL
+# ===================================================================
+
+_SERVER_CHILD = """
+import os, sys, threading
+sys.path.insert(0, sys.argv[3])
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.services.network.ledger import Network
+from fabric_token_sdk_tpu.services.network.remote import LedgerServer
+
+wal_path, mode = sys.argv[1], sys.argv[2]
+validator = RequestValidator(FabTokenDriver(FabTokenPublicParams()))
+if mode == "recover":
+    net = Network.recover(validator, wal_path)
+else:
+    net = Network(validator, wal_path=wal_path)
+server = LedgerServer(network=net).start()
+print("READY", server.address[1], flush=True)
+threading.Event().wait()
+"""
+
+
+def _spawn_server(wal_path, mode):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_CHILD, str(wal_path), mode, REPO_ROOT],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"ledger child died rc={proc.returncode}:\n{proc.stderr.read()}"
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if ready:
+            line = proc.stdout.readline()
+            assert line.startswith("READY"), f"unexpected child output {line!r}"
+            return proc, int(line.split()[1])
+    proc.kill()
+    raise AssertionError("ledger child never became ready")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_ledger_server_recovers_from_wal(tmp_path):
+    """Acceptance: SIGKILL a LedgerServer subprocess mid-workload;
+    restart it via Network.recover. Every tx the client saw finality for
+    is still VALID, a double spend of a recovered-spent token is
+    rejected, and the (artificially) torn final WAL record is discarded
+    cleanly."""
+    wal_path = str(tmp_path / "node.wal")
+    child, port = _spawn_server(wal_path, "fresh")
+    child2 = None
+    try:
+        client = RemoteNetwork(("127.0.0.1", port), timeout=10,
+                               retries=2, backoff_s=0.01)
+        pp = FabTokenPublicParams()
+        parties, issuer, alice, bob = build_env(lambda: FabTokenDriver(pp), client)
+        issue_to(parties, alice, [2] * 6, "seed")
+        alice_p = parties["alice-node"]
+        ids = alice_p.vault.token_ids()
+        reqs = [
+            manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"t-{i}")
+            for i, tid in enumerate(ids)
+        ]
+        # a conflicting spend of t-0's input, prepared BEFORE the kill
+        dup = manual_transfer(alice_p, ids[0], 2, bob.recipient_identity(), "dup")
+
+        acked = ["seed"]
+        for i in range(3):  # definitely-acknowledged prefix
+            ev = client.submit(reqs[i].to_bytes())
+            assert ev.status == TxStatus.VALID
+            acked.append(f"t-{i}")
+
+        # mid-workload kill: t-3/t-4 race SIGKILL from a second thread
+        def straggler():
+            for i in (3, 4):
+                try:
+                    ev = client.submit(reqs[i].to_bytes())
+                    if ev.status == TxStatus.VALID:
+                        acked.append(f"t-{i}")
+                except (ConnectionError, OSError):
+                    return
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        time.sleep(0.02)
+        os.kill(child.pid, signal.SIGKILL)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        child.wait(timeout=30)
+
+        # torn tail: simulate a crash mid-append of the NEXT record
+        assert os.path.getsize(wal_path) > 0
+        with open(wal_path, "ab") as fh:
+            fh.write(struct.pack(">II", 1 << 20, 0) + b"torn")
+
+        child2, port2 = _spawn_server(wal_path, "recover")
+        client2 = RemoteNetwork(("127.0.0.1", port2), timeout=10,
+                                retries=2, backoff_s=0.01)
+        # every acknowledged tx survived the SIGKILL
+        assert client2.height() >= len(acked)
+        for anchor in acked:
+            assert client2.status(anchor).status == TxStatus.VALID, anchor
+        for anchor in acked:
+            if anchor == "seed":
+                continue
+            i = int(anchor.split("-")[1])
+            assert client2.exists(ID(anchor, 0))
+            assert not client2.exists(ID("seed", i))
+        # the in-flight stragglers either committed (and are consistent)
+        # or were lost before the WAL append — never half-applied
+        for i in (3, 4):
+            ev = client2.status(f"t-{i}")
+            assert ev is None or ev.status == TxStatus.VALID
+            if ev is not None:
+                assert not client2.exists(ID("seed", i))
+        # no double spend accepted post-recovery
+        ev = client2.submit(dup.to_bytes())
+        assert ev.status == TxStatus.INVALID
+        assert "already spent" in ev.message
+        # and the recovered node accepts genuinely new work
+        ev = client2.submit(reqs[5].to_bytes())
+        assert ev.status == TxStatus.VALID
+    finally:
+        for c in (child, child2):
+            if c is not None and c.poll() is None:
+                c.kill()
