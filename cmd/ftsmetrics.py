@@ -124,6 +124,22 @@ def show(path: str, prometheus: bool = False) -> None:
             f" batched={batched} host={host} batched_frac={frac:.2f}"
         )
 
+    # one-line prove-plane health: how much proof GENERATION rode the
+    # batched device prover vs the host prover (and device-error
+    # fallbacks — nonzero fallbacks mean the degrade-only contract fired)
+    p_batches = ctr.get("batch.prove.batches", 0)
+    p_txs = ctr.get("batch.prove.txs", 0)
+    p_host = ctr.get("batch.prove.host", 0)
+    p_fall = ctr.get("batch.prove.host_fallbacks", 0)
+    if p_batches or p_host or p_fall:
+        denom = p_txs + p_host
+        frac = p_txs / denom if denom else 0.0
+        print(
+            f"prove summary: batches={p_batches} device_txs={p_txs}"
+            f" host={p_host} host_fallbacks={p_fall}"
+            f" device_frac={frac:.2f}"
+        )
+
     # one-line durability health: journal traffic, recovery/torn-tail
     # events, injected chaos, and client-side retry pressure
     wal_appends = ctr.get("wal.appends", 0)

@@ -1,9 +1,11 @@
-"""Precompile the verifier data plane into the persistent XLA cache.
+"""Precompile the verify + prove data planes into the persistent XLA cache.
 
 Usage:
     python cmd/ftswarmup.py                 # full set (stages + pairing)
     python cmd/ftswarmup.py --no-pairing    # group-math stage tiles only
+    python cmd/ftswarmup.py --no-prover     # skip prover-only programs
     python cmd/ftswarmup.py --list          # show the program inventory
+                                            # (tagged verify/prove planes)
 
 Prints ONE JSON summary line, e.g.:
     {"metric": "warmup", "programs": 12, "seconds": 412.3,
@@ -43,6 +45,12 @@ def main(argv=None) -> int:
         help="skip the (large) miller/product/final-exp pairing tiles",
     )
     ap.add_argument(
+        "--no-prover",
+        action="store_true",
+        help="skip programs used only by the batched prover "
+        "(the shared verify+prove tiles still compile)",
+    )
+    ap.add_argument(
         "--list",
         action="store_true",
         help="list the canonical program inventory without compiling",
@@ -58,8 +66,14 @@ def main(argv=None) -> int:
     from fabric_token_sdk_tpu.utils import metrics as mx
 
     if args.list:
-        for name, _fn, shapes in wu.all_programs(not args.no_pairing):
-            print(f"{name:<24} {' x '.join(str(s) for s in shapes)}")
+        for name, _fn, shapes in wu.all_programs(
+            not args.no_pairing, not args.no_prover
+        ):
+            planes = f"[{wu.program_planes(name)}]"
+            print(
+                f"{name:<24} {planes:<16} "
+                f"{' x '.join(str(s) for s in shapes)}"
+            )
         return 0
 
     mx.enable(True)
@@ -74,7 +88,9 @@ def main(argv=None) -> int:
                   file=sys.stderr, flush=True)
 
     summary = wu.warmup(
-        include_pairing=not args.no_pairing, progress=progress
+        include_pairing=not args.no_pairing,
+        include_prover=not args.no_prover,
+        progress=progress,
     )
     summary.pop("per_program", None)
     print(json.dumps({"metric": "warmup", **summary}), flush=True)

@@ -128,6 +128,24 @@ class Driver(abc.ABC):
         no batched plane (default)."""
         return None
 
+    def batch_prover(self):
+        """The driver's batched transfer-proof GENERATOR (the prove-side
+        twin of `batch_verifier`), or None when the driver proves on the
+        host only (default)."""
+        return None
+
+    def transfer_many(self, transfers: Sequence[tuple], rng=None,
+                      min_batch=None):
+        """Batch-prove SPI: build many transfer actions at once.
+        `transfers` holds tuples of `transfer()`'s positional arguments;
+        outcomes come back in request order. Default: sequential
+        `transfer()` calls — the abstract `transfer()` takes no rng, so
+        `rng`/`min_batch` are ignored here; drivers that thread
+        randomness or batch proof generation override this (zkatdlog
+        routes same-shape groups of >= min_batch through
+        `TransferProver.batch`)."""
+        return [self.transfer(*spec) for spec in transfers]
+
     # ------------------------------------------------------------ tokens
 
     @abc.abstractmethod

@@ -9,10 +9,10 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-import jax.numpy as jnp
+import numpy as np
 
 from . import hostmath as hm
-from ..ops import curve as cv
+from ..ops import curve as cv, limbs as lb, stages as st
 
 
 def commit(openings: Sequence[int], bases: Sequence, curve=None):
@@ -23,20 +23,41 @@ def commit(openings: Sequence[int], bases: Sequence, curve=None):
 
 
 class BatchedPedersen:
-    """Batched fixed-base committer: B commitments over the same bases in
-    one device program (one-hot window lookups + tree add)."""
+    """Batched fixed-base committer over the compile-once stage tiles.
+
+    B commitments over the same bases run as ROW_TILE slabs of the
+    canonical `g1_msm` tile (`ops/stages.py`), so the program count is
+    independent of B — this is the commit engine of the batched transfer
+    prover (`crypto/batch_prove.py`: WF announcements, digit
+    commitments, equality announcements are all Pedersen rows here)."""
 
     def __init__(self, bases: Sequence):
         self.bases = list(bases)
         self.table = cv.FixedBaseTable(self.bases)
 
+    def commit_rows(self, scalars: np.ndarray) -> np.ndarray:
+        """Canonical limb scalars (N, nbases, NLIMBS) -> (N, 3, NLIMBS)
+        Jacobian numpy, via the shape-invariant msm stage tile."""
+        return st.g1_msm_rows(self.table.flat, scalars)
+
+    def commit_ints(self, openings_rows: Sequence[Sequence[int]]):
+        """Host int rows -> (host points, device Jacobian): one flat limb
+        encode, one tiled msm pass, one host decode."""
+        rows = list(openings_rows)
+        flat = cv.encode_scalars([s for row in rows for s in row])
+        jac = self.commit_rows(
+            flat.reshape(len(rows), len(self.bases), lb.NLIMBS)
+        )
+        return cv.decode_points(jac), jac
+
     def commit_batch(self, openings_rows: Sequence[Sequence[int]]):
         """rows of per-base openings -> list of host G1 points."""
-        scal = jnp.stack([cv.encode_scalars(row) for row in openings_rows])
-        return cv.decode_points(self.table.msm(scal))
+        return self.commit_ints(openings_rows)[0]
 
     def commit_device(self, scalars):
-        """Device path: scalars (..., nbases, NLIMBS) canonical -> points."""
+        """Fused device path: scalars (..., nbases, NLIMBS) canonical ->
+        points. NOTE: compiles one program PER leading shape — prefer
+        `commit_rows` (stage tiles) anywhere the shape varies."""
         return self.table.msm(scalars)
 
 

@@ -133,6 +133,42 @@ class RangeVerifier:
             raise ValueError("invalid range proof")
 
 
+@dataclass
+class RangeDraw:
+    """Witness decomposition + commit-phase randomness of one range proof.
+
+    Drawn once, then consumed by either the host commit path
+    (`RangeProver.prove`) or the batched device commit path
+    (`crypto/batch_prove.py`); the response phase (`RangeProver.finish`)
+    is shared, so device proving can only accelerate — never change —
+    the emitted proof distribution.
+    """
+
+    digits: List[List[int]]  # per token: little-endian digits
+    digit_bfs: List[List[int]]  # per (token, digit): commitment blinding
+    mem: List[List[sigproof.MembershipDraw]]  # per (token, digit)
+    rho_T: int
+    rho_v: List[int]
+    rho_tb: List[int]
+    rho_cb: List[int]
+    agg_bfs: List[int]  # per token: sum bf_i * base^i
+
+    def equality_token_rows(self) -> List[List[int]]:
+        """Scalar rows of the per-token equality commitments over the 3
+        Pedersen bases (host `g1_multiexp` / device `g1_msm3` tile)."""
+        return [
+            [self.rho_T, self.rho_v[k], self.rho_tb[k]]
+            for k in range(len(self.digits))
+        ]
+
+    def equality_value_rows(self) -> List[List[int]]:
+        """Scalar rows of the per-token digit-aggregate commitments over
+        ped[:2] (host `g1_multiexp` / device `g1_msm2` tile)."""
+        return [
+            [self.rho_v[k], self.rho_cb[k]] for k in range(len(self.digits))
+        ]
+
+
 class RangeProver(RangeVerifier):
     def __init__(
         self, witnesses: Sequence[TokenWitness], tokens, signatures, base, exponent,
@@ -143,48 +179,76 @@ class RangeProver(RangeVerifier):
         self.signatures = list(signatures)  # PS signatures on 0..base-1
         self.rng = rng
 
+    def draw(self) -> RangeDraw:
+        n = len(self.tokens)
+        digits = [
+            decompose(self.witnesses[k].value, self.base, self.exponent)
+            for k in range(n)
+        ]
+        digit_bfs = [
+            [hm.rand_zr(self.rng) for _ in range(self.exponent)] for _ in range(n)
+        ]
+        mem = [
+            [sigproof.membership_draw(self.rng) for _ in range(self.exponent)]
+            for _ in range(n)
+        ]
+        agg_bfs = [
+            sum(
+                digit_bfs[k][i] * (self.base**i) for i in range(self.exponent)
+            ) % hm.R
+            for k in range(n)
+        ]
+        return RangeDraw(
+            digits=digits,
+            digit_bfs=digit_bfs,
+            mem=mem,
+            rho_T=hm.rand_zr(self.rng),
+            rho_v=[hm.rand_zr(self.rng) for _ in range(n)],
+            rho_tb=[hm.rand_zr(self.rng) for _ in range(n)],
+            rho_cb=[hm.rand_zr(self.rng) for _ in range(n)],
+            agg_bfs=agg_bfs,
+        )
+
+    def finish(
+        self, d: RangeDraw, digit_coms: List[List[tuple]],
+        mem_proofs: List[List[sigproof.MembershipProof]], chal: int,
+    ) -> bytes:
+        type_hash = hm.hash_to_zr(self.witnesses[0].token_type.encode())
+        return RangeProof(
+            challenge=chal,
+            type_resp=schnorr.respond([type_hash], [d.rho_T], chal)[0],
+            value_resps=schnorr.respond([w.value for w in self.witnesses], d.rho_v, chal),
+            token_bf_resps=schnorr.respond([w.bf for w in self.witnesses], d.rho_tb, chal),
+            com_bf_resps=schnorr.respond(d.agg_bfs, d.rho_cb, chal),
+            digit_commitments=digit_coms,
+            membership_proofs=mem_proofs,
+        ).to_bytes()
+
     def prove(self) -> bytes:
         n = len(self.tokens)
+        d = self.draw()
         digit_coms: List[List[tuple]] = []
         mem_proofs: List[List[sigproof.MembershipProof]] = []
-        agg_bfs: List[int] = []
         for k in range(n):
-            digits = decompose(self.witnesses[k].value, self.base, self.exponent)
             row_coms, row_proofs = [], []
-            agg_bf = 0
-            for i, d in enumerate(digits):
-                bf = hm.rand_zr(self.rng)
-                com = hm.g1_multiexp(self.ped[:2], [d, bf])
-                w = sigproof.MembershipWitness(self.signatures[d], d, bf)
+            for i, dig in enumerate(d.digits[k]):
+                bf = d.digit_bfs[k][i]
+                com = hm.g1_multiexp(self.ped[:2], [dig, bf])
+                w = sigproof.MembershipWitness(self.signatures[dig], dig, bf)
                 mp = sigproof.MembershipProver(
                     w, com, self.P, self.Q, self.pk, self.ped[:2], self.rng
                 )
                 row_coms.append(com)
-                row_proofs.append(mp.prove())
-                agg_bf = (agg_bf + bf * (self.base**i)) % hm.R
+                row_proofs.append(mp.prove(d.mem[k][i]))
             digit_coms.append(row_coms)
             mem_proofs.append(row_proofs)
-            agg_bfs.append(agg_bf)
 
         # equality sigma proof
-        rho_T = hm.rand_zr(self.rng)
-        rho_v = [hm.rand_zr(self.rng) for _ in range(n)]
-        rho_tb = [hm.rand_zr(self.rng) for _ in range(n)]
-        rho_cb = [hm.rand_zr(self.rng) for _ in range(n)]
         com_tokens = [
-            hm.g1_multiexp(self.ped, [rho_T, rho_v[k], rho_tb[k]]) for k in range(n)
+            hm.g1_multiexp(self.ped, row) for row in d.equality_token_rows()
         ]
         com_values = [
-            hm.g1_multiexp(self.ped[:2], [rho_v[k], rho_cb[k]]) for k in range(n)
+            hm.g1_multiexp(self.ped[:2], row) for row in d.equality_value_rows()
         ]
         chal = self._challenge(com_tokens, com_values, digit_coms)
-        type_hash = hm.hash_to_zr(self.witnesses[0].token_type.encode())
-        return RangeProof(
-            challenge=chal,
-            type_resp=schnorr.respond([type_hash], [rho_T], chal)[0],
-            value_resps=schnorr.respond([w.value for w in self.witnesses], rho_v, chal),
-            token_bf_resps=schnorr.respond([w.bf for w in self.witnesses], rho_tb, chal),
-            com_bf_resps=schnorr.respond(agg_bfs, rho_cb, chal),
-            digit_commitments=digit_coms,
-            membership_proofs=mem_proofs,
-        ).to_bytes()
+        return self.finish(d, digit_coms, mem_proofs, chal)

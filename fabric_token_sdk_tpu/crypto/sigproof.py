@@ -172,6 +172,62 @@ class MembershipWitness:
     com_bf: int  # blinding factor of the Pedersen commitment
 
 
+@dataclass
+class MembershipDraw:
+    """Commit-phase randomness of one membership proof. Drawn up front so
+    the host prover and the batched device prover (`crypto/batch_prove.py`)
+    share one response path (`membership_finish`): the device plane only
+    accelerates the group/pairing algebra of the commit phase.
+
+    `r`       — PS signature randomizer (sigma' = sigma^r)
+    `sig_bf`  — signature obfuscation blinding (S'' = S' + P^sig_bf)
+    `rho_v`   — randomness for the committed value
+    `rho_cb`  — randomness for the Pedersen commitment blinding
+    `rho_h`   — randomness for the PS hash message
+    `rho_bf`  — randomness for the signature blinding factor
+    """
+
+    r: int
+    sig_bf: int
+    rho_v: int
+    rho_cb: int
+    rho_h: int
+    rho_bf: int
+
+
+def membership_draw(rng=None) -> MembershipDraw:
+    return MembershipDraw(
+        r=hm.rand_zr(rng),
+        sig_bf=hm.rand_zr(rng),
+        rho_v=hm.rand_zr(rng),
+        rho_cb=hm.rand_zr(rng),
+        rho_h=hm.rand_zr(rng),
+        rho_bf=hm.rand_zr(rng),
+    )
+
+
+def membership_finish(
+    w: MembershipWitness, d: MembershipDraw, obf: pssign.Signature,
+    chal: int, commitment,
+) -> MembershipProof:
+    """Fiat-Shamir response phase (pure Zr arithmetic — always host)."""
+    msg_hash = pssign.hash_messages([w.value])
+    z = schnorr.respond(
+        [w.value, w.com_bf, msg_hash, d.sig_bf],
+        [d.rho_v, d.rho_cb, d.rho_h, d.rho_bf],
+        chal,
+    )
+    return MembershipProof(
+        challenge=chal,
+        signature=obf,
+        value_resp=z[0],
+        com_bf_resp=z[1],
+        hash_resp=z[2],
+        sig_bf_resp=z[3],
+        commitment=commitment,
+    )
+
+
 class MembershipVerifier:
     """Checks a committed value is PS-signed (reference membership.go)."""
 
@@ -213,27 +269,25 @@ class MembershipProver(MembershipVerifier):
         self.w = witness
         self.rng = rng
 
-    def prove(self) -> MembershipProof:
-        pok_prover = POKProver(
-            self.pok.pk, self.pok.Q, self.pok.P, self.w.signature, [self.w.value], self.rng
+    def prove(self, d: Optional[MembershipDraw] = None) -> MembershipProof:
+        if d is None:
+            d = membership_draw(self.rng)
+        rnd, obf = self.obfuscate(d)
+        t = self.pok._message_term([d.rho_v], d.rho_h)
+        com_gt = hm.pairing_product(
+            [(rnd.R, t), (hm.g1_mul(self.pok.P, d.rho_bf), self.pok.Q)]
         )
-        rnd, obf, bf = pok_prover.obfuscate()
-        com_gt, rho_m, rho_h, rho_bf = pok_prover.commit(rnd)
-        rho_cb = hm.rand_zr(self.rng)
-        com_val = hm.g1_multiexp(self.ped, [rho_m[0], rho_cb])
+        com_val = hm.g1_multiexp(self.ped, [d.rho_v, d.rho_cb])
         chal = self._challenge(com_gt, com_val, obf)
-        msg_hash = pssign.hash_messages([self.w.value])
-        z = schnorr.respond(
-            [self.w.value, self.w.com_bf, msg_hash, bf],
-            [rho_m[0], rho_cb, rho_h, rho_bf],
-            chal,
+        return membership_finish(self.w, d, obf, chal, self.commitment)
+
+    def obfuscate(self, d: MembershipDraw):
+        """sigma' = sigma^r; sigma'' = (R', S' + P^sig_bf) — the host
+        version of the batched prover's variable-base scalar-mul stage."""
+        rnd = pssign.Signature(
+            hm.g1_mul(self.w.signature.R, d.r), hm.g1_mul(self.w.signature.S, d.r)
         )
-        return MembershipProof(
-            challenge=chal,
-            signature=obf,
-            value_resp=z[0],
-            com_bf_resp=z[1],
-            hash_resp=z[2],
-            sig_bf_resp=z[3],
-            commitment=self.commitment,
+        obf = pssign.Signature(
+            rnd.R, hm.g1_add(rnd.S, hm.g1_mul(self.pok.P, d.sig_bf))
         )
+        return rnd, obf

@@ -7,6 +7,7 @@ range proof is skipped for 1-in-1-out ownership transfers) and
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -15,6 +16,13 @@ from .setup import PublicParams
 from .serialization import guard, dumps, loads
 from .token import TokenDataWitness
 from ..utils import metrics as mx
+
+
+def _prove_min_batch() -> int:
+    try:
+        return max(1, int(os.environ.get("FTS_PROVE_MIN_BATCH", "2")))
+    except ValueError:
+        return 2
 
 
 @dataclass
@@ -87,6 +95,68 @@ class TransferProver:
                 wf=self.wf_prover.prove(),
                 range_correctness=self.range_prover.prove() if self.range_prover else None,
             ).to_bytes()
+
+    @classmethod
+    def batch(
+        cls,
+        requests: Sequence[tuple],
+        pp: PublicParams,
+        rng=None,
+        min_batch: Optional[int] = None,
+        prover=None,
+    ) -> List[bytes]:
+        """Prove many transfers, routing same-shape groups of at least
+        `min_batch` (default FTS_PROVE_MIN_BATCH=2) through the batched
+        device plane (`crypto/batch_prove.py` over the `ops/stages.py`
+        tiles). Degrade-only contract, same as block validation: ANY
+        device-plane error falls back to the host prover for that group
+        — batching can only accelerate, never lose, a proof.
+
+        `requests`: tuples of `(in_witnesses, out_witnesses, inputs,
+        outputs)` — the host constructor's arguments. Returns proof bytes
+        in request order, byte-compatible with `prove()` output.
+        """
+        reqs = list(requests)
+        if not reqs:
+            return []
+        if min_batch is None:
+            min_batch = _prove_min_batch()
+
+        groups = {}
+        for idx, r in enumerate(reqs):
+            groups.setdefault((len(r[2]), len(r[3])), []).append(idx)
+
+        out: List[Optional[bytes]] = [None] * len(reqs)
+
+        def host(indices, fallback=False):
+            # counted per tx AFTER each successful prove, so an exception
+            # mid-group (malformed witness etc.) never overcounts; the
+            # fallback counter likewise only records txs the host plane
+            # actually recovered — a request both planes reject is caller
+            # error, not a device fault
+            for i in indices:
+                iw, ow, inputs, outputs = reqs[i]
+                out[i] = cls(iw, ow, inputs, outputs, pp, rng).prove()
+                mx.counter("batch.prove.host").inc()
+                if fallback:
+                    mx.counter("batch.prove.host_fallbacks").inc()
+
+        for shape, indices in sorted(groups.items()):
+            if len(indices) < min_batch:
+                host(indices)
+                continue
+            try:
+                if prover is None:
+                    # lazy: host-only callers never pull in the jax stack
+                    from .batch_prove import prover_for
+
+                    prover = prover_for(pp)
+                proofs = prover.prove([reqs[i] for i in indices], rng)
+                for i, p in zip(indices, proofs):
+                    out[i] = p
+            except Exception:
+                host(indices, fallback=True)
+        return out
 
 
 class TransferVerifier:

@@ -67,6 +67,36 @@ class TransferWFWitness:
     out_bfs: List[int]
 
 
+@dataclass
+class TransferWFDraw:
+    """Commit-phase randomness of one transfer WF proof — drawn once,
+    consumed by either the host or the batched-device commit path (the
+    Fiat-Shamir response math in `finish` is shared by both)."""
+
+    rho_T: int
+    rho_sum: int
+    rho_iv: List[int]
+    rho_ib: List[int]
+    rho_ov: List[int]
+    rho_ob: List[int]
+
+    def commit_rows(self, n_in: int, n_out: int) -> List[List[int]]:
+        """Scalar rows of the commit phase over the 3 Pedersen bases, in
+        transcript order: per-input commitments, input sum, per-output
+        commitments, output sum. Every commitment is one fixed-base
+        3-term multiexp — on host via `hm.g1_multiexp`, on device via the
+        `g1_msm3` stage tile (`crypto/batch_prove.py`)."""
+        rows = [
+            [self.rho_T, self.rho_iv[i], self.rho_ib[i]] for i in range(n_in)
+        ]
+        rows.append([self.rho_T * n_in, self.rho_sum, sum(self.rho_ib)])
+        rows += [
+            [self.rho_T, self.rho_ov[i], self.rho_ob[i]] for i in range(n_out)
+        ]
+        rows.append([self.rho_T * n_out, self.rho_sum, sum(self.rho_ob)])
+        return rows
+
+
 class TransferWFProver:
     def __init__(self, witness: TransferWFWitness, ped_params, inputs, outputs, rng=None):
         self.w = witness
@@ -75,46 +105,44 @@ class TransferWFProver:
         self.outputs = list(outputs)
         self.rng = rng
 
-    def prove(self) -> bytes:
-        w, pp = self.w, self.pp
+    def draw(self) -> TransferWFDraw:
+        w = self.w
         if len(w.in_values) != len(self.inputs) or len(w.out_values) != len(self.outputs):
             raise ValueError("transfer WF: malformed witness")
-        rho_T = _rand(self.rng)
-        rho_sum = _rand(self.rng)
-        rho_iv = [_rand(self.rng) for _ in self.inputs]
-        rho_ib = [_rand(self.rng) for _ in self.inputs]
-        rho_ov = [_rand(self.rng) for _ in self.outputs]
-        rho_ob = [_rand(self.rng) for _ in self.outputs]
-
-        Q = hm.g1_mul(pp[0], rho_T)
-        com_in = [
-            hm.g1_add(Q, hm.g1_multiexp(pp[1:3], [rho_iv[i], rho_ib[i]]))
-            for i in range(len(self.inputs))
-        ]
-        com_out = [
-            hm.g1_add(Q, hm.g1_multiexp(pp[1:3], [rho_ov[i], rho_ob[i]]))
-            for i in range(len(self.outputs))
-        ]
-        # sums: g0^{rho_T*n} g1^{rho_sum} g2^{sum rho_b}
-        in_sum = hm.g1_multiexp(
-            pp[:3], [rho_T * len(self.inputs), rho_sum, sum(rho_ib)]
-        )
-        out_sum = hm.g1_multiexp(
-            pp[:3], [rho_T * len(self.outputs), rho_sum, sum(rho_ob)]
+        return TransferWFDraw(
+            rho_T=_rand(self.rng),
+            rho_sum=_rand(self.rng),
+            rho_iv=[_rand(self.rng) for _ in self.inputs],
+            rho_ib=[_rand(self.rng) for _ in self.inputs],
+            rho_ov=[_rand(self.rng) for _ in self.outputs],
+            rho_ob=[_rand(self.rng) for _ in self.outputs],
         )
 
-        chal = challenge_transfer_wf(com_in, in_sum, com_out, out_sum, self.inputs, self.outputs)
-
+    def finish(self, d: TransferWFDraw, chal: int) -> bytes:
+        w = self.w
         t_hash = hm.hash_to_zr(w.token_type.encode())
         return TransferWF(
-            input_values=schnorr.respond(w.in_values, rho_iv, chal),
-            input_bfs=schnorr.respond(w.in_bfs, rho_ib, chal),
-            output_values=schnorr.respond(w.out_values, rho_ov, chal),
-            output_bfs=schnorr.respond(w.out_bfs, rho_ob, chal),
-            type_resp=schnorr.respond([t_hash], [rho_T], chal)[0],
-            sum_resp=schnorr.respond([sum(w.in_values) % hm.R], [rho_sum], chal)[0],
+            input_values=schnorr.respond(w.in_values, d.rho_iv, chal),
+            input_bfs=schnorr.respond(w.in_bfs, d.rho_ib, chal),
+            output_values=schnorr.respond(w.out_values, d.rho_ov, chal),
+            output_bfs=schnorr.respond(w.out_bfs, d.rho_ob, chal),
+            type_resp=schnorr.respond([t_hash], [d.rho_T], chal)[0],
+            sum_resp=schnorr.respond([sum(w.in_values) % hm.R], [d.rho_sum], chal)[0],
             challenge=chal,
         ).to_bytes()
+
+    def prove(self) -> bytes:
+        d = self.draw()
+        coms = [
+            hm.g1_multiexp(self.pp[:3], [r % hm.R for r in row])
+            for row in d.commit_rows(len(self.inputs), len(self.outputs))
+        ]
+        n_in = len(self.inputs)
+        chal = challenge_transfer_wf(
+            coms[:n_in], coms[n_in], coms[n_in + 1 : -1], coms[-1],
+            self.inputs, self.outputs,
+        )
+        return self.finish(d, chal)
 
 
 def challenge_transfer_wf(com_in, in_sum, com_out, out_sum, inputs, outputs) -> int:

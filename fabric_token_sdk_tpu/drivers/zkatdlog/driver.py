@@ -27,6 +27,7 @@ class ZKATDLogDriver(Driver):
     def __init__(self, pp: PublicParams):
         self.pp = pp
         self._batch_verifier = None
+        self._batch_prover = None
 
     def public_params(self) -> PublicParams:
         return self.pp
@@ -63,8 +64,12 @@ class ZKATDLogDriver(Driver):
         )
         return IssueOutcome(action_bytes=action, outputs=outputs, metadata=metadata)
 
-    def transfer(self, input_ids, input_tokens, input_metadata, token_type, values,
-                 owners, rng=None) -> TransferOutcome:
+    def _transfer_parts(self, input_ids, input_tokens, input_metadata, token_type,
+                        values, owners, rng):
+        """Everything of a transfer EXCEPT proof generation: witness
+        decode/checks and fresh output commitments. Returns the prove
+        request consumed by `TransferProver`/`TransferProver.batch` plus
+        the assembly context."""
         if len(values) != len(owners):
             raise ValueError("transfer: values/owners length mismatch")
         in_tokens = [ZkToken.from_bytes(raw) for raw in input_tokens]
@@ -78,14 +83,17 @@ class ZKATDLogDriver(Driver):
         out_commitments, out_witnesses = tokens_with_witness(
             list(values), token_type, self.pp.ped_params, rng
         )
-        proof = transfer_mod.TransferProver(
+        prove_req = (
             in_witnesses,
             out_witnesses,
             [t.data for t in in_tokens],
             out_commitments,
-            self.pp,
-            rng,
-        ).prove()
+        )
+        return prove_req, (input_ids, input_tokens, token_type, values, owners)
+
+    def _assemble_transfer(self, ctx, prove_req, proof) -> TransferOutcome:
+        input_ids, input_tokens, token_type, values, owners = ctx
+        _, out_witnesses, _, out_commitments = prove_req
         outputs = [
             ZkToken(owner=o, data=c).to_bytes() for o, c in zip(owners, out_commitments)
         ]
@@ -102,6 +110,36 @@ class ZKATDLogDriver(Driver):
             }
         )
         return TransferOutcome(action_bytes=action, outputs=outputs, metadata=metadata)
+
+    def transfer(self, input_ids, input_tokens, input_metadata, token_type, values,
+                 owners, rng=None) -> TransferOutcome:
+        prove_req, ctx = self._transfer_parts(
+            input_ids, input_tokens, input_metadata, token_type, values, owners, rng
+        )
+        proof = transfer_mod.TransferProver(*prove_req, self.pp, rng).prove()
+        return self._assemble_transfer(ctx, prove_req, proof)
+
+    def transfer_many(self, transfers: Sequence[tuple], rng=None,
+                      min_batch=None) -> List[TransferOutcome]:
+        """Batch-prove SPI: build many transfer actions in one pass, with
+        proof generation routed through the batched device prover
+        (`TransferProver.batch` groups same-shape requests; groups below
+        `min_batch` — default FTS_PROVE_MIN_BATCH — and any device-plane
+        failure take the host prover — degrade-only, same contract as
+        block validation).
+
+        `transfers`: tuples of `transfer()`'s positional arguments
+        `(input_ids, input_tokens, input_metadata, token_type, values,
+        owners)`. Returns outcomes in request order.
+        """
+        parts = [self._transfer_parts(*spec, rng) for spec in transfers]
+        proofs = transfer_mod.TransferProver.batch(
+            [req for req, _ in parts], self.pp, rng=rng, min_batch=min_batch,
+        )
+        return [
+            self._assemble_transfer(ctx, req, proof)
+            for (req, ctx), proof in zip(parts, proofs)
+        ]
 
     # ------------------------------------------------------------ validate
 
@@ -199,6 +237,16 @@ class ZKATDLogDriver(Driver):
 
             self._batch_verifier = BatchedTransferVerifier(self.pp)
         return self._batch_verifier
+
+    def batch_prover(self):
+        """Cached `BatchedTransferProver` — the prove-side twin of
+        `batch_verifier` (lazy import for the same reason; shares the
+        module-level `prover_for` cache with `TransferProver.batch`)."""
+        if self._batch_prover is None:
+            from ...crypto.batch_prove import prover_for
+
+            self._batch_prover = prover_for(self.pp)
+        return self._batch_prover
 
     # ------------------------------------------------------------ tokens
 

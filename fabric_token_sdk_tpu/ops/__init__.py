@@ -20,11 +20,118 @@ import os as _os
 
 import jax as _jax
 
+
+# ------------------------------------------------------- cache host keying
+#
+# XLA AOT cache entries bake in the compiling host's CPU features; loading
+# an entry produced on a different machine triggers cpu_aot_loader
+# machine-feature-mismatch warnings ("could lead to SIGILL") and, worse,
+# can crash mid-kernel (the BENCH_r05 rc=124). The persistent cache dir is
+# therefore HOST-KEYED: the first process writes a HOST_FINGERPRINT marker
+# (platform + codegen-relevant CPU flags); any later process whose
+# fingerprint differs is diverted to a per-host subdirectory, so foreign
+# AOT entries are NEVER loaded. Diversions count the entries they skipped
+# under `jax.cache.foreign_skipped`. Opt out: FTS_CACHE_FINGERPRINT=0.
+
+_FINGERPRINT_MARKER = "HOST_FINGERPRINT"
+
+# CPU-feature flags that change XLA:CPU codegen (vector ISA + carryless
+# mul/AES used by some kernels); hypervisor/power-management flags are
+# deliberately excluded so equivalent VMs of one fleet share a cache.
+_CODEGEN_FLAG_PREFIXES = (
+    "sse", "ssse", "avx", "fma", "bmi", "f16c", "aes", "pclmul",
+    "popcnt", "movbe", "adx", "sha", "vaes", "gfni", "amx",
+)
+
+
+def host_fingerprint() -> str:
+    """Stable fingerprint of this host's codegen-relevant CPU surface."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.system()]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith(("flags", "features")):
+                    feats = sorted(
+                        f for f in line.split(":", 1)[1].split()
+                        if f.startswith(_CODEGEN_FLAG_PREFIXES)
+                    )
+                    parts.append(" ".join(feats))
+                    break
+    except OSError:  # non-Linux: machine/system only
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _resolve_cache_dir(base: str, fingerprint: str) -> str:
+    """Claim `base` for this host, or divert to a host-keyed subdir.
+
+    * no marker: write one — this host owns the cache from now on;
+    * marker matches: reuse the (warm) cache;
+    * marker differs: the cache was populated on a FOREIGN host — count
+      its entries under `jax.cache.foreign_skipped` and use
+      `base/host-<fingerprint>` instead, so no mismatched AOT entry is
+      ever handed to the loader.
+    """
+    from ..utils import metrics as _mx
+
+    marker = _os.path.join(base, _FINGERPRINT_MARKER)
+    try:
+        _os.makedirs(base, exist_ok=True)
+        try:
+            # O_EXCL claim: exactly ONE host ever wins an unclaimed dir —
+            # a lost race falls through to reading the winner's marker,
+            # so two first-run hosts on a shared FS can never both write
+            # AOT entries into the same dir
+            fd = _os.open(marker, _os.O_WRONLY | _os.O_CREAT | _os.O_EXCL)
+            with _os.fdopen(fd, "w") as fh:
+                fh.write(fingerprint + "\n")
+            return base
+        except FileExistsError:
+            pass
+        with open(marker) as fh:
+            recorded = fh.read().strip()
+        if not recorded:
+            # torn claim (a claimant died between O_EXCL create and
+            # write): repair it, otherwise host-keying would be silently
+            # disabled forever — the exact mixed-host hazard this guards
+            with open(marker, "w") as fh:
+                fh.write(fingerprint + "\n")
+            return base
+        if recorded != fingerprint:
+            # count real AOT entries only (each program has a `-cache`
+            # payload file; `-atime` companions and stray files would
+            # double the number) — fall back to every file when the
+            # naming convention is absent
+            names = [
+                n
+                for n in _os.listdir(base)
+                if n != _FINGERPRINT_MARKER
+                and _os.path.isfile(_os.path.join(base, n))
+            ]
+            entries = [n for n in names if n.endswith("-cache")] or names
+            _mx.REGISTRY.counter("jax.cache.foreign_skipped").inc(len(entries))
+            _mx.REGISTRY.set_meta(
+                "jax.cache.foreign_host", f"{recorded}!={fingerprint}"
+            )
+            sub = _os.path.join(base, f"host-{fingerprint}")
+            _os.makedirs(sub, exist_ok=True)
+            return sub
+    except OSError:
+        # unwritable/unreadable cache dir: let jax handle (or reject) it
+        pass
+    return base
+
+
 # Persistent compilation cache: the pairing/Miller programs are large and
 # XLA (esp. :CPU) compiles them slowly; cache them across processes.
 _cache_dir = _os.environ.get(
     "FTS_TPU_JAX_CACHE", _os.path.expanduser("~/.cache/fts_tpu_jax")
 )
+if _os.environ.get("FTS_CACHE_FINGERPRINT", "1") != "0":
+    _cache_dir = _resolve_cache_dir(_cache_dir, host_fingerprint())
 try:
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

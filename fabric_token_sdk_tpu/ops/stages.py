@@ -35,6 +35,9 @@ persistent cache ahead of time.
 
 from __future__ import annotations
 
+import os
+from concurrent.futures import ThreadPoolExecutor
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -95,7 +98,40 @@ _g2_to_affine_tile = jax.jit(cv2.to_affine_device)
 
 # ------------------------------------------------------------ tile runner
 
-def run_rows(kernel, *arrays, consts=()):
+def default_dp() -> int:
+    """Data-parallel shard count for the stage runner (FTS_DP_SHARDS,
+    default 1 = unsharded). Both the batched verify plane
+    (`crypto/batch.py`) and the batched prover (`crypto/batch_prove.py`)
+    flow through `run_rows`, so one knob shards both."""
+    try:
+        return max(1, int(os.environ.get("FTS_DP_SHARDS", "1")))
+    except ValueError:
+        return 1
+
+
+def _run_span(kernel, consts, arrays, start, stop):
+    """Sequentially run the tile kernel over [start, stop) row slabs."""
+    return [
+        kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
+        for t in range(start, stop, ROW_TILE)
+    ]
+
+
+def dp_spans(ntiles: int, dp: int):
+    """Split `ntiles` ROW_TILE slabs into at most `dp` contiguous,
+    tile-aligned (start_tile, stop_tile) spans — the row partition of the
+    per-shard stage-tile dispatch (`parallel/sharding.py`)."""
+    dp = max(1, min(dp, ntiles))
+    per, extra = divmod(ntiles, dp)
+    spans, at = [], 0
+    for s in range(dp):
+        n = per + (1 if s < extra else 0)
+        spans.append((at, at + n))
+        at += n
+    return spans
+
+
+def run_rows(kernel, *arrays, consts=(), dp=None):
     """Run `kernel(*consts, *tiles)` over ROW_TILE slabs of flat-row
     numpy arrays -> numpy. The staged successor of the old
     `crypto.batch._run_tiled`.
@@ -108,6 +144,12 @@ def run_rows(kernel, *arrays, consts=()):
       host-side copy at most, only when padding is needed); the only
       host->device transfers are the per-tile `jnp.asarray` calls,
       counted in `batch.tiled.transfers`.
+    * `dp` > 1 (default `FTS_DP_SHARDS`) splits the tile range into
+      contiguous spans dispatched from worker threads — same executable,
+      same results, overlapping host glue with device work. Device
+      placement is intentionally NOT pinned per shard: per-device
+      executables have distinct compile-cache keys, which would break
+      the compile-once/warm-cache guarantees (see ARCHITECTURE.md).
     """
     N = arrays[0].shape[0]
     if N == 0:
@@ -128,10 +170,22 @@ def run_rows(kernel, *arrays, consts=()):
     mx.counter("stages.rows").inc(N)
     mx.counter("stages.tiles").inc(ntiles)
     mx.counter("batch.tiled.transfers").inc(ntiles * len(arrays))
-    outs = [
-        kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
-        for t in range(0, N + pad, ROW_TILE)
-    ]
+    dp = default_dp() if dp is None else max(1, dp)
+    if dp > 1 and ntiles > 1:
+        spans = dp_spans(ntiles, dp)
+        mx.counter("stages.sharded_calls").inc()
+        mx.counter("stages.shards").inc(len(spans))
+        with ThreadPoolExecutor(max_workers=len(spans)) as pool:
+            futs = [
+                pool.submit(
+                    _run_span, kernel, consts, arrays,
+                    a * ROW_TILE, b * ROW_TILE,
+                )
+                for a, b in spans
+            ]
+            outs = [o for f in futs for o in f.result()]
+    else:
+        outs = _run_span(kernel, consts, arrays, 0, N + pad)
     if isinstance(outs[0], (tuple, list)):
         return tuple(
             np.concatenate([np.asarray(o[i]) for o in outs])[:N]

@@ -36,7 +36,8 @@ _COMPILES = "jax.core.compile.backend_compile_duration.seconds"
 def pairing_programs() -> Iterable[Tuple[str, object, tuple]]:
     """The staged pairing tile programs (miller / per-K product /
     final-exp), canonical shapes. K covers every verifier pairing product:
-    2 legs (Pointcheval-Sanders) and 4 legs (membership)."""
+    2 legs (Pointcheval-Sanders, and the membership GT pre-commitment on
+    the prove side) and 4 legs (membership verify)."""
     L = lb.NLIMBS
     yield (
         "miller_tile",
@@ -48,10 +49,37 @@ def pairing_programs() -> Iterable[Tuple[str, object, tuple]]:
     yield ("final_exp_tile", pr.final_exp, ((pr.FEXP_TILE, 6, 2, L),))
 
 
-def all_programs(include_pairing: bool = True):
+# Program-set classification for `cmd/ftswarmup.py --list` and the
+# `--no-prover` opt-out. The batched prover (`crypto/batch_prove.py`) is
+# BY CONSTRUCTION a composition of the same canonical tiles as the
+# verify plane — its only private program is the Jacobian add tile (the
+# signature-obfuscation step S'' = S' + P^bf); everything else is
+# shared, which is what lets the post-warmup zero-cache-miss guarantee
+# extend to proving without growing the program set.
+PROVER_PROGRAMS = frozenset(
+    {
+        "g1_msm1_tile", "g1_msm2_tile", "g1_msm3_tile",
+        "g1_mul_tile", "g1_add_tile",
+        "g2_mul_tile", "g2_add_tile", "g2_to_affine_tile",
+        "miller_tile", "gt_product_k2_tile", "final_exp_tile",
+    }
+)
+PROVER_ONLY_PROGRAMS = frozenset({"g1_add_tile"})
+
+
+def program_planes(name: str) -> str:
+    """'verify', 'prove', or 'verify+prove' for a canonical program."""
+    if name in PROVER_ONLY_PROGRAMS:
+        return "prove"
+    return "verify+prove" if name in PROVER_PROGRAMS else "verify"
+
+
+def all_programs(include_pairing: bool = True, include_prover: bool = True):
     progs = list(st.stage_programs())
     if include_pairing:
         progs += list(pairing_programs())
+    if not include_prover:
+        progs = [p for p in progs if p[0] not in PROVER_ONLY_PROGRAMS]
     return progs
 
 
@@ -59,6 +87,7 @@ def warmup(
     include_pairing: bool = True,
     persist_all: bool = True,
     progress: Optional[callable] = None,
+    include_prover: bool = True,
 ) -> dict:
     """AOT-lower and compile every canonical program; returns a summary.
 
@@ -81,7 +110,7 @@ def warmup(
     t_total = time.time()
     try:
         with mx.span("warmup.precompile", include_pairing=include_pairing):
-            for name, fn, shapes in all_programs(include_pairing):
+            for name, fn, shapes in all_programs(include_pairing, include_prover):
                 specs = [jax.ShapeDtypeStruct(s, jnp.int32) for s in shapes]
                 t0 = time.time()
                 fn.lower(*specs).compile()

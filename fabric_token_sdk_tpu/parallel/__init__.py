@@ -1,6 +1,8 @@
 from .sharding import (  # noqa: F401
     make_mesh,
+    mesh_dp,
+    run_rows_dp,
     shard_rows,
     sharded_pairing_product,
-    sharded_wf_verify_kernel,
+    sharded_schnorr_rows,
 )

@@ -1,4 +1,4 @@
-"""Mesh sharding of batched proof verification.
+"""Mesh sharding of batched proof generation + verification.
 
 Scale-out model (SURVEY §2, TPU-scale subsystems): proof batches are
 data-parallel over a `dp` mesh axis; the K legs of pairing products can
@@ -6,8 +6,25 @@ additionally shard over an `mp` axis, combined with an `all_gather`
 collective before the shared final exponentiation — the ICI-friendly
 layout (batch stays put, only 12-coefficient GT values move).
 
-The reference scales by adding Fabric endorser processes; here one program
-spans all chips of a slice via `jax.sharding.Mesh` + `shard_map`.
+Two complementary mechanisms:
+
+* **Per-shard stage-tile dispatch** (`run_rows_dp`,
+  `sharded_schnorr_rows`) — the dp axis partitions the FLAT ROW stream
+  of the staged execution model (`ops/stages.py`): each shard walks its
+  contiguous span of canonical ROW_TILE slabs through the SAME
+  compile-once tile executables, so sharding adds ZERO new XLA programs.
+  This is the dispatch used by both the batched verify plane
+  (`crypto/batch.py`) and the batched prover (`crypto/batch_prove.py`)
+  via `stages.run_rows(dp=...)` / `FTS_DP_SHARDS`. (The pre-stage-tile
+  `sharded_wf_verify_kernel`, which shard_map'ed a fused per-shape
+  reconstruction kernel — the exact program-explosion the stage tiles
+  removed — is deleted.)
+* **`shard_map` pairing product** (`sharded_pairing_product`) — the
+  dp x mp showcase for the one kernel where an in-program collective
+  pays: Miller legs shard over mp and all_gather before final exp.
+
+The reference scales by adding Fabric endorser processes; here one mesh
+spans all chips of a slice via `jax.sharding.Mesh`.
 """
 
 from __future__ import annotations
@@ -21,7 +38,7 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import curve as cv, pairing as pr, tower as tw
+from ..ops import curve as cv, pairing as pr, stages as st, tower as tw
 from ..ops.field import FP
 
 
@@ -75,20 +92,37 @@ def sharded_pairing_product(Ps, Qs, mesh: Mesh):
     return run(Ps, Qs)
 
 
-def sharded_wf_verify_kernel(table: cv.FixedBaseTable, resp, stmts, chals,
-                             mesh: Mesh):
-    """Batch-parallel Schnorr commitment reconstruction over dp."""
+def mesh_dp(mesh: Optional[Mesh]) -> Optional[int]:
+    """The dp extent of a mesh (None mesh -> ambient FTS_DP_SHARDS)."""
+    return None if mesh is None else int(mesh.shape["dp"])
 
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp")),
-        out_specs=P("dp"),
-        check_rep=False,
+
+def run_rows_dp(kernel, *arrays, mesh: Optional[Mesh] = None,
+                dp: Optional[int] = None, consts=()):
+    """Per-shard stage-tile dispatch: partition the flat rows into dp
+    contiguous ROW_TILE-aligned spans and run each span through the
+    canonical compile-once tile executable (`stages.run_rows`). Results
+    are bit-identical to the unsharded runner and NO new XLA program is
+    compiled — the dp axis exists purely in the host-side dispatch."""
+    return st.run_rows(
+        kernel, *arrays, consts=consts,
+        dp=dp if dp is not None else mesh_dp(mesh),
     )
-    def run(r, s, c):
-        fixed = table.msm(r)
-        sc = cv.scalar_mul(s, c[:, None, :])
-        return cv.add(fixed, cv.neg(sc))
 
-    return run(resp, stmts, chals)
+
+def sharded_schnorr_rows(table: cv.FixedBaseTable, resp, stmts, chals,
+                         mesh: Optional[Mesh] = None):
+    """Batch-parallel Schnorr commitment reconstruction over dp, as
+    per-shard stage-tile dispatch: com = table^resp - stmt^chal.
+
+    The flat-row composition is EXACTLY the one `BatchedWFVerifier`
+    runs (msm tile, variable-base mul tile, sub tile) — dp only
+    partitions the row stream. resp: (N, nbases, L), stmts: (N, 3, L),
+    chals: (N, L) canonical limbs (host numpy); returns (N, 3, L)
+    Jacobian numpy."""
+    dp = mesh_dp(mesh)
+    fixed = run_rows_dp(st._g1_msm_tile, np.asarray(resp), dp=dp,
+                        consts=(table.flat,))
+    sc = run_rows_dp(cv.scalar_mul, np.asarray(stmts), np.asarray(chals),
+                     dp=dp)
+    return run_rows_dp(st._g1_sub_tile, fixed, sc, dp=dp)

@@ -1,0 +1,189 @@
+"""Batched device prover vs host prover/verifier (differential guarantee:
+device proving may only accelerate, never change, accept/reject)."""
+import random
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.crypto import batch, batch_prove, hostmath as hm
+from fabric_token_sdk_tpu.crypto import token as tok, transfer as tr
+from fabric_token_sdk_tpu.crypto import wellformedness as wf
+from fabric_token_sdk_tpu.crypto.rangeproof import RangeProof
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+
+@pytest.fixture(scope="module")
+def pp():
+    return setup(base=4, exponent=2, rng=random.Random(0xF75))
+
+
+def _reqs(pp, rng, in_vals, out_vals, count):
+    """Prove-request tuples (in_w, out_w, inputs, outputs), conservation
+    respected by the caller's choice of values."""
+    out = []
+    for _ in range(count):
+        in_toks, in_w = tok.tokens_with_witness(in_vals, "USD", pp.ped_params, rng)
+        out_toks, out_w = tok.tokens_with_witness(out_vals, "USD", pp.ped_params, rng)
+        out.append((in_w, out_w, in_toks, out_toks))
+    return out
+
+
+def _host_verify(pp, req, proof):
+    tr.TransferVerifier(req[2], req[3], pp).verify(proof)
+
+
+def test_batched_prove_accepted_by_host_and_batched_verifier(rng, pp):
+    """1-in/1-out (range skipped): every device-produced proof verifies
+    under the unchanged host verifier AND the batched verifier."""
+    reqs = _reqs(pp, rng, [7], [7], 3)
+    txs_before = mx.REGISTRY.counter("batch.prove.txs").value
+    proofs = tr.TransferProver.batch(reqs, pp, rng=rng, min_batch=1)
+    assert mx.REGISTRY.counter("batch.prove.txs").value - txs_before == 3
+    for req, proof in zip(reqs, proofs):
+        _host_verify(pp, req, proof)
+    got = batch.BatchedTransferVerifier(pp).verify(
+        [(r[2], r[3], p) for r, p in zip(reqs, proofs)]
+    )
+    assert got.tolist() == [True, True, True]
+
+
+def test_batched_prove_tamper_rejected(rng, pp):
+    """A bit-flipped device proof must be rejected by the host verifier
+    and by the batched verifier — same accept/reject as host proofs."""
+    reqs = _reqs(pp, rng, [9], [9], 2)
+    proofs = tr.TransferProver.batch(reqs, pp, rng=rng, min_batch=1)
+    tp = tr.TransferProof.from_bytes(proofs[0])
+    bad_wf = wf.TransferWF.from_bytes(tp.wf)
+    bad_wf.sum_resp = (bad_wf.sum_resp + 1) % hm.R
+    tp.wf = bad_wf.to_bytes()
+    bad = tp.to_bytes()
+    with pytest.raises(ValueError):
+        _host_verify(pp, reqs[0], bad)
+    got = batch.BatchedTransferVerifier(pp).verify(
+        [(reqs[0][2], reqs[0][3], bad), (reqs[1][2], reqs[1][3], proofs[1])]
+    )
+    assert got.tolist() == [False, True]
+
+
+def test_empty_batch_returns_cleanly(pp):
+    assert tr.TransferProver.batch([], pp) == []
+    assert batch_prove.prover_for(pp).prove([]) == []
+
+
+def test_below_min_batch_routes_host(rng, pp):
+    """Groups smaller than min_batch never touch the device plane."""
+    reqs = _reqs(pp, rng, [5], [5], 2)
+    host_before = mx.REGISTRY.counter("batch.prove.host").value
+    txs_before = mx.REGISTRY.counter("batch.prove.txs").value
+    proofs = tr.TransferProver.batch(reqs, pp, rng=rng, min_batch=5)
+    assert mx.REGISTRY.counter("batch.prove.host").value - host_before == 2
+    assert mx.REGISTRY.counter("batch.prove.txs").value == txs_before
+    for req, proof in zip(reqs, proofs):
+        _host_verify(pp, req, proof)
+
+
+def test_device_error_falls_back_to_host(rng, pp, monkeypatch):
+    """Degrade-only contract: ANY device-plane failure yields host-proved
+    (still valid) proofs and counts batch.prove.host_fallbacks."""
+
+    class Boom:
+        def prove(self, reqs, rng=None):
+            raise MemoryError("injected device fault")
+
+    monkeypatch.setattr(batch_prove, "prover_for", lambda pp: Boom())
+    reqs = _reqs(pp, rng, [3], [3], 2)
+    fall_before = mx.REGISTRY.counter("batch.prove.host_fallbacks").value
+    proofs = tr.TransferProver.batch(reqs, pp, rng=rng, min_batch=1)
+    assert (
+        mx.REGISTRY.counter("batch.prove.host_fallbacks").value - fall_before
+        == 2
+    )
+    for req, proof in zip(reqs, proofs):
+        _host_verify(pp, req, proof)
+
+
+def test_mixed_shapes_return_in_request_order(rng, pp):
+    """batch() groups by shape internally; results come back in request
+    order. The odd-shaped singleton (below min_batch) takes the host
+    prover, the uniform group rides the device plane."""
+    device = _reqs(pp, rng, [4], [4], 2)
+    odd = _reqs(pp, rng, [5, 10], [7, 8], 1)
+    reqs = [device[0], odd[0], device[1]]
+    host_before = mx.REGISTRY.counter("batch.prove.host").value
+    txs_before = mx.REGISTRY.counter("batch.prove.txs").value
+    proofs = tr.TransferProver.batch(reqs, pp, rng=rng, min_batch=2)
+    assert mx.REGISTRY.counter("batch.prove.host").value - host_before == 1
+    assert mx.REGISTRY.counter("batch.prove.txs").value - txs_before == 2
+    for req, proof in zip(reqs, proofs):
+        _host_verify(pp, req, proof)
+
+
+def test_uniform_shape_required_by_device_prover(rng, pp):
+    """The raw BatchedTransferProver rejects mixed shapes (batch() is the
+    router that handles grouping)."""
+    reqs = _reqs(pp, rng, [4], [4], 1) + _reqs(pp, rng, [5, 5], [6, 4], 1)
+    with pytest.raises(ValueError, match="uniform"):
+        batch_prove.prover_for(pp).prove(reqs)
+
+
+@pytest.mark.slow
+def test_batched_prove_full_range_differential(rng, pp):
+    """2-in/2-out: the full WF + range + membership device prove path.
+    Every proof accepted by host AND batched verifiers; a tampered
+    membership response is rejected by both."""
+    reqs = _reqs(pp, rng, [5, 10], [7, 8], 3)
+    prover = batch_prove.prover_for(pp)
+    proofs = prover.prove(reqs, rng)
+    for req, proof in zip(reqs, proofs):
+        _host_verify(pp, req, proof)
+    bv = batch.BatchedTransferVerifier(pp)
+    got = bv.verify([(r[2], r[3], p) for r, p in zip(reqs, proofs)])
+    assert got.tolist() == [True, True, True]
+
+    tp = tr.TransferProof.from_bytes(proofs[1])
+    rpf = RangeProof.from_bytes(tp.range_correctness)
+    rpf.membership_proofs[0][0].value_resp = (
+        rpf.membership_proofs[0][0].value_resp + 1
+    ) % hm.R
+    tp.range_correctness = rpf.to_bytes()
+    bad = tp.to_bytes()
+    with pytest.raises(ValueError):
+        _host_verify(pp, reqs[1], bad)
+    got = bv.verify(
+        [(reqs[1][2], reqs[1][3], bad), (reqs[0][2], reqs[0][3], proofs[0])]
+    )
+    assert got.tolist() == [False, True]
+
+
+@pytest.mark.slow
+def test_transfer_many_driver_spi(rng, pp):
+    """driver.transfer_many proofs validate through the unchanged
+    validate_transfer host path (2-in/2-out incl. range)."""
+    from fabric_token_sdk_tpu.crypto import sign
+    from fabric_token_sdk_tpu.drivers import identity
+    from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+    from fabric_token_sdk_tpu.models.token import ID
+
+    driver = ZKATDLogDriver(pp)
+    key = sign.keygen(rng)
+    ident = identity.pk_identity(key.public)
+    outcome = driver.issue(
+        ident, "USD", [100, 55] * 2, [ident] * 4, anonymous=True, rng=rng
+    )
+    resolve = {ID("iss", i): outcome.outputs[i] for i in range(4)}
+    specs = [
+        (
+            [ID("iss", 2 * i), ID("iss", 2 * i + 1)],
+            outcome.outputs[2 * i : 2 * i + 2],
+            outcome.metadata[2 * i : 2 * i + 2],
+            "USD", [120, 35], [ident, ident],
+        )
+        for i in range(2)
+    ]
+    touts = driver.transfer_many(specs, rng=rng)
+    sig = [key.sign(b"payload", rng), key.sign(b"payload", rng)]
+    for tout in touts:
+        driver.validate_transfer(
+            tout.action_bytes, lambda x: resolve[x], b"payload", sig
+        )

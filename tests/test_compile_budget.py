@@ -154,6 +154,125 @@ def test_block_validation_compiles_zero_programs_after_warmup(rng, pp):
     )
 
 
+def test_foreign_cache_dir_is_never_loaded(tmp_path):
+    """A persistent cache populated on a DIFFERENT host (mismatched
+    HOST_FINGERPRINT marker) must be diverted away from — its AOT entries
+    carry foreign CPU features ("could lead to SIGILL", the BENCH_r05
+    rc=124) — with the skipped entries counted under
+    `jax.cache.foreign_skipped`. A matching or unclaimed dir is reused."""
+    from fabric_token_sdk_tpu import ops
+
+    fp = ops.host_fingerprint()
+    assert fp == ops.host_fingerprint(), "fingerprint must be stable"
+    base = str(tmp_path / "cache")
+
+    # unclaimed: this host claims it and uses it directly
+    assert ops._resolve_cache_dir(base, fp) == base
+    marker = tmp_path / "cache" / "HOST_FINGERPRINT"
+    assert marker.read_text().strip() == fp
+    # claimed by this host: reused
+    assert ops._resolve_cache_dir(base, fp) == base
+
+    # claimed by a foreign host holding two AOT entries: diverted, and
+    # exactly the `-cache` payload files counted (not `-atime` companions)
+    marker.write_text("feedfacefeedface\n")
+    (tmp_path / "cache" / "jit_foo-cache").write_bytes(b"aot")
+    (tmp_path / "cache" / "jit_foo-atime").write_bytes(b"t")
+    (tmp_path / "cache" / "jit_bar-cache").write_bytes(b"aot")
+    before = mx.REGISTRY.counter("jax.cache.foreign_skipped").value
+    got = ops._resolve_cache_dir(base, fp)
+    assert got == str(tmp_path / "cache" / f"host-{fp}")
+    assert (
+        mx.REGISTRY.counter("jax.cache.foreign_skipped").value - before == 2
+    )
+    # the diverted dir resolves consistently on the next process
+    assert ops._resolve_cache_dir(base, fp) == got
+
+    # a torn claim (empty marker: claimant died mid-write) is repaired,
+    # not treated as a permanent wildcard match
+    marker.write_text("")
+    assert ops._resolve_cache_dir(base, fp) == base
+    assert marker.read_text().strip() == fp
+
+
+def _prove_reqs(pp, rng, in_vals, out_vals, count):
+    reqs = []
+    for _ in range(count):
+        in_toks, in_w = tok.tokens_with_witness(in_vals, "USD", pp.ped_params, rng)
+        out_toks, out_w = tok.tokens_with_witness(out_vals, "USD", pp.ped_params, rng)
+        reqs.append((in_w, out_w, in_toks, out_toks))
+    return reqs
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTS_WARMUP") != "1",
+    reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",
+)
+def test_batch_prove_compiles_zero_programs_after_warmup(rng, pp):
+    """Non-slow guard for the PROVE plane: after the session warmup,
+    batch-proving — including a NEW `(n_in, n_out)` shape — must miss
+    the compilation cache zero times and compile zero new programs: the
+    batched prover is a composition of the same canonical tiles the
+    warmup set covers (`warmup.PROVER_PROGRAMS`)."""
+    from fabric_token_sdk_tpu.crypto import batch_prove, transfer as tr
+
+    prover = batch_prove.BatchedTransferProver(pp)
+    misses_before = mx.REGISTRY.counter(
+        "jax.compilation_cache.cache_misses"
+    ).value
+    reqs = _prove_reqs(pp, rng, [5, 10], [7, 8], 2)
+    proofs = prover.prove(reqs, rng)
+
+    before = _compiles()
+    reqs2 = _prove_reqs(pp, rng, [9], [4, 3, 2], 1)
+    proofs2 = prover.prove(reqs2, rng)
+    assert _compiles() - before == 0, (
+        "a new transfer shape compiled new XLA programs — the batched "
+        "prover escaped the canonical stage-tile set"
+    )
+    misses = (
+        mx.REGISTRY.counter("jax.compilation_cache.cache_misses").value
+        - misses_before
+    )
+    assert misses == 0, (
+        f"batch proving missed the compilation cache {misses} time(s) "
+        "after warmup() — warmup.PROVER_PROGRAMS is incomplete"
+    )
+    # the device-proved proofs are real: the host verifier accepts them
+    for (_, _, inputs, outputs), proof in zip(reqs + reqs2, proofs + proofs2):
+        tr.TransferVerifier(inputs, outputs, pp).verify(proof)
+
+
+@pytest.mark.slow
+def test_batched_prover_program_budget_and_shape_invariance(rng, pp):
+    """Full device prove path (WF + range + membership pairing): at most
+    TRANSFER_PROGRAM_BUDGET distinct programs ever — the prover adds only
+    the tiny Jacobian-add tile beyond the verify set — and a second,
+    differently-shaped batch compiles ZERO new programs."""
+    from fabric_token_sdk_tpu.crypto import batch_prove, transfer as tr
+
+    prover = batch_prove.BatchedTransferProver(pp)
+    before = _compiles()
+    reqs = _prove_reqs(pp, rng, [5, 10], [7, 8], 2)
+    proofs = prover.prove(reqs, rng)
+    first = _compiles() - before
+    assert first <= TRANSFER_PROGRAM_BUDGET, (
+        f"staged prove path compiled {first} programs "
+        f"(budget {TRANSFER_PROGRAM_BUDGET})"
+    )
+
+    before = _compiles()
+    reqs2 = _prove_reqs(pp, rng, [9], [5, 4], 1)
+    proofs2 = prover.prove(reqs2, rng)
+    assert _compiles() - before == 0, (
+        "a new transfer shape compiled new XLA programs — the staged "
+        "prove path must be shape-invariant"
+    )
+
+    for (_, _, inputs, outputs), proof in zip(reqs + reqs2, proofs + proofs2):
+        tr.TransferVerifier(inputs, outputs, pp).verify(proof)
+
+
 @pytest.mark.slow
 def test_transfer_verifier_program_budget_and_shape_invariance(rng, pp):
     """Full staged BatchedTransferVerifier (WF + membership pairing +

@@ -1,49 +1,88 @@
-"""Sharded kernels on the 8-virtual-device CPU mesh == unsharded results."""
+"""Sharded dispatch on the 8-virtual-device CPU mesh == unsharded results."""
 import random
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from fabric_token_sdk_tpu.crypto import hostmath as hm
-from fabric_token_sdk_tpu.ops import curve as cv
-from fabric_token_sdk_tpu.parallel import make_mesh, shard_rows, sharded_wf_verify_kernel
+from fabric_token_sdk_tpu.ops import curve as cv, stages as st
+from fabric_token_sdk_tpu.parallel import (
+    make_mesh,
+    mesh_dp,
+    run_rows_dp,
+    sharded_schnorr_rows,
+)
+from fabric_token_sdk_tpu.utils import metrics as mx
 
 
 def test_mesh_shapes():
     assert len(jax.devices()) == 8
     mesh = make_mesh(8, mp=2)
     assert mesh.shape == {"dp": 4, "mp": 2}
+    assert mesh_dp(mesh) == 4
     with pytest.raises(ValueError):
         make_mesh(8, mp=3)
 
 
-def test_sharded_schnorr_kernel_matches_host(rng):
+def test_dp_spans_are_tile_aligned_and_cover():
+    """The per-shard dispatch partitions the tile range exactly: spans
+    are contiguous, non-overlapping, and never exceed the shard count."""
+    for ntiles in (1, 2, 3, 7, 8, 13):
+        for dp in (1, 2, 4, 8, 32):
+            spans = st.dp_spans(ntiles, dp)
+            assert len(spans) == min(dp, ntiles)
+            assert spans[0][0] == 0 and spans[-1][1] == ntiles
+            for (a, b), (c, _) in zip(spans, spans[1:]):
+                assert a < b == c
+
+
+def test_sharded_schnorr_rows_matches_host(rng):
+    """Per-shard stage-tile dispatch of the Schnorr reconstruction (the
+    WF verify composition) over dp == host math, and sharding compiles
+    ZERO new programs (same canonical tile executables)."""
     bases = [hm.rand_g1(rng) for _ in range(3)]
     table = cv.FixedBaseTable(bases)
-    mesh = make_mesh(8, mp=1)
-    B, n = 8, 2
-    resp = np.zeros((B, n, 3, 32), dtype=np.int32)
-    stmt = np.zeros((B, n, 3, 32), dtype=np.int32)
-    chal = np.zeros((B, 32), dtype=np.int32)
+    mesh = make_mesh(8, mp=2)  # dp=4
+    N = 18  # 3 tiles of 8 rows (padded) split across 4 dp shards
+    resp = np.zeros((N, 3, 32), dtype=np.int32)
+    stmt = np.zeros((N, 3, 32), dtype=np.int32)
+    chal = np.zeros((N, 32), dtype=np.int32)
     expected = []
-    for b in range(B):
+    for i in range(N):
         c = rng.randrange(hm.R)
-        chal[b] = np.asarray(cv.encode_scalars([c]))[0]
-        for j in range(n):
-            zs = [rng.randrange(hm.R) for _ in range(3)]
-            st = hm.rand_g1(rng)
-            stmt[b, j] = cv.encode_point(st)
-            resp[b, j] = np.asarray(cv.encode_scalars(zs))
-            expected.append(
-                hm.g1_add(hm.g1_multiexp(bases, zs), hm.g1_neg(hm.g1_mul(st, c)))
-            )
-    out = sharded_wf_verify_kernel(
-        table, shard_rows(resp, mesh), shard_rows(stmt, mesh),
-        shard_rows(chal, mesh), mesh,
+        zs = [rng.randrange(hm.R) for _ in range(3)]
+        pt = hm.rand_g1(rng)
+        chal[i] = np.asarray(cv.encode_scalars([c]))[0]
+        stmt[i] = cv.encode_point(pt)
+        resp[i] = np.asarray(cv.encode_scalars(zs))
+        expected.append(
+            hm.g1_add(hm.g1_multiexp(bases, zs), hm.g1_neg(hm.g1_mul(pt, c)))
+        )
+    # warm the tiles (may compile on a cold cache), then pin zero-new
+    unsharded = sharded_schnorr_rows(table, resp, stmt, chal, mesh=None)
+    compiles = "jax.core.compile.backend_compile_duration.seconds"
+    before = mx.REGISTRY.histogram(compiles).count
+    sharded_before = mx.REGISTRY.counter("stages.sharded_calls").value
+    out = sharded_schnorr_rows(table, resp, stmt, chal, mesh)
+    assert mx.REGISTRY.histogram(compiles).count - before == 0, (
+        "dp sharding compiled a new program -- the per-shard dispatch must "
+        "reuse the canonical tile executables"
     )
+    assert mx.REGISTRY.counter("stages.sharded_calls").value > sharded_before
     assert cv.decode_points(out) == expected
+    assert cv.decode_points(unsharded) == expected
+
+
+def test_run_rows_dp_parity(rng):
+    """run_rows_dp over any dp equals the unsharded stage runner."""
+    pts = np.stack(
+        [cv.encode_point(hm.rand_g1(rng)) for _ in range(11)]
+    )
+    base = st.g1_add_rows(pts, pts)
+    for dp in (2, 3, 8):
+        got = run_rows_dp(cv.add, pts, pts, dp=dp)
+        assert np.array_equal(got, base)
 
 
 @pytest.mark.slow
