@@ -24,10 +24,14 @@ products each) kernels plus host Fiat-Shamir re-hashing.
 Observability: the run emits phase-stamped heartbeat lines to stderr
 (`[fts-bench] phase=warmup_compile elapsed=134s total=250s`) and flushes
 a metrics sidecar JSON (per-phase wall times, compile/cache counters,
-pipeline histograms) on exit, SIGTERM, or the internal deadline — so
-even a timed-out run (rc=124) leaves a full accounting. Sidecar path:
-$FTS_METRICS_SIDECAR (default BENCH.metrics.json). Inspect with
-`python cmd/ftsmetrics.py show BENCH.metrics.json`.
+pipeline histograms) PLUS a flight-recorder sidecar (`*.flight.json`:
+the last N lifecycle events — phases, submits, block cuts, verify
+decisions, WAL appends, compiles) on exit, SIGTERM, or the internal
+deadline — so even a timed-out run (rc=124) leaves a full accounting of
+*what was happening*, not just final counters. Sidecar path:
+$FTS_METRICS_SIDECAR (default BENCH.metrics.json; flight dump derived).
+Inspect with `python cmd/ftsmetrics.py show BENCH.metrics.json` and
+`python cmd/ftstrace.py tail BENCH.flight.json`.
 """
 
 from __future__ import annotations
@@ -182,6 +186,17 @@ def _arm_deadline(platform: str) -> None:
             return  # JSON already printed: never clobber a finished run
         mx = _metrics()
         mx.REGISTRY.set_meta("deadline_fired_s", deadline)
+        # the flight ring's death marker, recorded BEFORE the platform
+        # branch: the accelerator path re-execs (flushing sidecars on the
+        # way out) and never reaches _degraded_json — the pre-exec
+        # flight dump must still carry the bench.deadline event the
+        # rc=124 runbook looks for
+        mx.flight(
+            "bench.deadline", deadline_s=deadline, platform=platform,
+            phase=mx.REGISTRY.snapshot().get("meta", {}).get(
+                "progress.phase", "unknown"
+            ),
+        )
         print(
             f"[fts-bench] DEADLINE after {deadline:.0f}s on platform="
             f"{platform}: flushing metrics sidecar and "
@@ -493,6 +508,7 @@ def main() -> None:
     # the watchdog) BEFORE the fallible block phase, so a hang or crash
     # there can never cost the completed accelerator measurement.
     print(json.dumps(result), flush=True)
+    mx.flight("bench.result", value=result["value"], platform=platform)
     _done.set()
 
     # product-path block pipeline (orderer + batched block validation);

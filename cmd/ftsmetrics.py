@@ -140,6 +140,19 @@ def show(path: str, prometheus: bool = False) -> None:
             f" device_frac={frac:.2f}"
         )
 
+    # one-line tracing health: how many distributed traces / trace-tagged
+    # spans this run produced, flight-recorder traffic, and ring dumps
+    # (assemble the actual timelines with cmd/ftstrace.py)
+    tr = ctr.get("trace.traces", 0)
+    fe = ctr.get("flight.events", 0)
+    if tr or fe:
+        print(
+            f"trace summary: traces={tr}"
+            f" spans={ctr.get('trace.spans', 0)}"
+            f" recorder_events={fe}"
+            f" dumps={ctr.get('flight.dumps', 0)}"
+        )
+
     # one-line durability health: journal traffic, recovery/torn-tail
     # events, injected chaos, and client-side retry pressure
     wal_appends = ctr.get("wal.appends", 0)

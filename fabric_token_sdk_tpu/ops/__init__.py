@@ -163,11 +163,18 @@ def _install_jax_monitoring() -> None:
 
         def _on_event(name, **kw):
             _mx.REGISTRY.counter(_event_name(name)).inc()
+            # cache traffic is a lifecycle event: a run that suddenly
+            # starts MISSING the persistent cache shows up in the flight
+            # ring right next to the phase that triggered it
+            if "compilation_cache" in name:
+                _mx.flight("cache", event=_event_name(name))
 
         def _on_duration(name, duration, **kw):
             # the histogram's own `count` is the event count — e.g. the
             # backend_compile histogram count IS the distinct-program count
             _mx.REGISTRY.histogram(_event_name(name) + ".seconds").observe(duration)
+            if "backend_compile" in name:
+                _mx.flight("compile", seconds=round(duration, 3))
 
         _mon.register_event_listener(_on_event)
         _mon.register_event_duration_secs_listener(_on_duration)

@@ -171,21 +171,26 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
     mx.counter("stages.tiles").inc(ntiles)
     mx.counter("batch.tiled.transfers").inc(ntiles * len(arrays))
     dp = default_dp() if dp is None else max(1, dp)
-    if dp > 1 and ntiles > 1:
-        spans = dp_spans(ntiles, dp)
-        mx.counter("stages.sharded_calls").inc()
-        mx.counter("stages.shards").inc(len(spans))
-        with ThreadPoolExecutor(max_workers=len(spans)) as pool:
-            futs = [
-                pool.submit(
-                    _run_span, kernel, consts, arrays,
-                    a * ROW_TILE, b * ROW_TILE,
-                )
-                for a, b in spans
-            ]
-            outs = [o for f in futs for o in f.result()]
-    else:
-        outs = _run_span(kernel, consts, arrays, 0, N + pad)
+    # per-stage device timing: one `stages.run` span per dispatch, named
+    # by the stage kernel — the per-kernel breakdown a critical-path
+    # trace (cmd/ftstrace.py) renders under the block's device verify
+    kname = getattr(kernel, "__name__", None) or type(kernel).__name__
+    with mx.span("stages.run", kernel=kname, rows=N, tiles=ntiles):
+        if dp > 1 and ntiles > 1:
+            spans = dp_spans(ntiles, dp)
+            mx.counter("stages.sharded_calls").inc()
+            mx.counter("stages.shards").inc(len(spans))
+            with ThreadPoolExecutor(max_workers=len(spans)) as pool:
+                futs = [
+                    pool.submit(
+                        _run_span, kernel, consts, arrays,
+                        a * ROW_TILE, b * ROW_TILE,
+                    )
+                    for a, b in spans
+                ]
+                outs = [o for f in futs for o in f.result()]
+        else:
+            outs = _run_span(kernel, consts, arrays, 0, N + pad)
     if isinstance(outs[0], (tuple, list)):
         return tuple(
             np.concatenate([np.asarray(o[i]) for o in outs])[:N]

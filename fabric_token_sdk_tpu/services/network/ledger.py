@@ -55,6 +55,9 @@ class FinalityEvent:
     # verdict — the submitter sees it, but nothing durable is recorded
     # (an identical resubmission may succeed). Never persisted.
     transient: bool = False
+    # id of the distributed trace this tx's lifecycle was recorded under
+    # (diagnostic only — never persisted, empty when tracing was off)
+    trace_id: str = ""
 
 
 @dataclass
@@ -175,21 +178,36 @@ class Network:
         caller wins the race). Returns the finality event (also pushed to
         subscribers)."""
         sub = self.submit_async(request_bytes)
-        with tracer.span("network.submit", tx=sub.request.anchor):
-            return sub.result()
+        # drive under the tx's trace (minted at enqueue, or the caller's
+        # — ttx / remote dispatch); a dedup'd resubmission has no trace
+        # of its own and use_trace(None) keeps any caller context live
+        with mx.use_trace(sub.trace):
+            with tracer.span("network.submit", tx=sub.request.anchor):
+                return sub.result()
 
     def submit_async(self, request_bytes: bytes) -> Submission:
         """Enqueue a request into ordering; returns a Submission handle
         whose `result()` waits for (and, if needed, drives) block commit."""
-        request = TokenRequest.from_bytes(request_bytes)
+        return self.submit_request(TokenRequest.from_bytes(request_bytes))
+
+    def submit_request(self, request: TokenRequest) -> Submission:
+        """`submit_async` for an already-parsed request (the remote
+        node's batched submit path decodes up front — no double parse).
+        The active trace context (or a fresh one, minted only when the
+        request actually enters ordering — dedup'd resubmissions never
+        mint orphan traces) is captured into the Submission so
+        block-commit spans land in this tx's trace."""
         with self._lock:
             known = self._status.get(request.anchor)
         if known is not None:  # idempotent resubmission
             mx.counter("network.submit.resubmissions").inc()
+            mx.flight("submit", tx=request.anchor, dedup=True)
             sub = Submission(None, request)
             sub._resolve(known)
             return sub
-        return self._orderer.enqueue(request)
+        ctx = mx.current_trace() or mx.new_trace()
+        with mx.use_trace(ctx):
+            return self._orderer.enqueue(request)
 
     def submit_many(self, requests_bytes: List[bytes]) -> List[FinalityEvent]:
         """Deterministic multi-tx blocks: enqueue everything, then cut +
@@ -244,31 +262,60 @@ class Network:
         if not fresh:
             return
         requests = [s.request for s in fresh]
-        with mx.span("ledger.block.validate", txs=len(requests)):
+        # queue-wait leg of the critical path: how long each submission
+        # sat in the ordering queue before this cut picked it up
+        cut_mono, cut_unix = time.monotonic(), time.time()
+        queue_wait_max = 0.0
+        for sub in fresh:
+            if sub.enqueued_at:
+                wait_s = max(0.0, cut_mono - sub.enqueued_at)
+                queue_wait_max = max(queue_wait_max, wait_s)
+                mx.histogram("ledger.block.queue_wait.seconds").observe(wait_s)
+                mx.record_span(
+                    "orderer.queue", sub.enqueued_unix, cut_unix,
+                    trace=sub.trace, tx=sub.request.anchor,
+                )
+        with mx.span("ledger.block.validate", txs=len(requests)) as blk:
             # Validation runs OUTSIDE the ledger lock: the device verify
             # (or a cold compile) and the per-tx host checks must not
             # starve concurrent reads. This is safe because the orderer's
             # commit lock serializes every state WRITER — readers under
             # `self._lock` simply observe consistent pre-block state
             # until the atomic merge below.
-            verdicts = self._pipeline.proof_verdicts(requests)
+            timings: dict = {}
+            verdicts = self._pipeline.proof_verdicts(requests, timings)
             commit_time = time.time()
             view = _BlockView(self._state, self._spent)
             events: List[FinalityEvent] = []
+            t0 = time.monotonic()
             for ti, request in enumerate(requests):
-                events.append(
-                    self._validate_tx(request, view, commit_time, verdicts.get(ti))
-                )
+                # per-tx validation runs under the TX's trace, not the
+                # committing thread's — whoever wins the commit race
+                with mx.use_trace(fresh[ti].trace):
+                    event = self._validate_tx(
+                        request, view, commit_time, verdicts.get(ti)
+                    )
+                if fresh[ti].trace is not None:
+                    event.trace_id = fresh[ti].trace.trace_id
+                events.append(event)
+            host_validate_s = time.monotonic() - t0
             faults.fire("ledger.commit_block")
             # WAL append BEFORE the atomic merge: once the record is
             # fsync'd the block is durable — a crash between here and the
             # merge redoes it on recovery (clients that never got an
             # answer re-learn the verdict via status()). A crash before
             # here loses only unacknowledged work.
+            wal_s = 0.0
             if self._wal is not None:
-                self._wal.append(
-                    self._wal_record(requests, events, view, commit_time)
+                t0 = time.monotonic()
+                record = self._wal_record(requests, events, view, commit_time)
+                self._wal.append(record)
+                wal_s = time.monotonic() - t0
+                mx.flight(
+                    "wal.append", block=len(self._blocks), bytes=len(record),
+                    txs=[e.tx_id for e in events if not e.transient],
                 )
+            t0 = time.monotonic()
             with self._lock:
                 # atomic apply + finalize; transient-fault events resolve
                 # their submitter but leave no durable trace
@@ -283,6 +330,30 @@ class Network:
                     if not event.transient:
                         self._status[event.tx_id] = event
                 self._record_block_metrics(requests, events, verdicts)
+            merge_s = time.monotonic() - t0
+            # per-block critical-path breakdown: where this block's wall
+            # time went (queue wait / grouping / device verify / host
+            # validate incl. fallbacks / WAL fsync / atomic merge)
+            breakdown = {
+                "queue_wait_max_s": round(queue_wait_max, 6),
+                "grouping_s": round(timings.get("grouping_s", 0.0), 6),
+                "device_verify_s": round(timings.get("device_verify_s", 0.0), 6),
+                "host_validate_s": round(host_validate_s, 6),
+                "wal_s": round(wal_s, 6),
+                "merge_s": round(merge_s, 6),
+            }
+            mx.histogram("ledger.block.host_validate.seconds").observe(
+                host_validate_s
+            )
+            mx.histogram("ledger.block.merge.seconds").observe(merge_s)
+            if blk is not None:
+                blk.attrs.update(breakdown)
+            mx.flight(
+                "block.commit", block=block.number,
+                txs=[r.anchor for r in requests],
+                traces=[s.trace.trace_id if s.trace else None for s in fresh],
+                **breakdown,
+            )
         # snapshot compaction: still under the orderer's commit lock (the
         # only WAL writer), outside the ledger lock (snapshot() retakes
         # it). The block is already durable in the journal by now, so a
@@ -501,6 +572,7 @@ class Network:
             net.snapshot_every = snapshot_every
         mx.counter("wal.recoveries").inc()
         mx.counter("wal.replayed.blocks").inc(replayed)
+        mx.flight("wal.recover", blocks=len(net._blocks), replayed=replayed)
         mx.gauge("network.height").set(len(net._blocks))
         logger.info(
             "ledger: recovered %d blocks (%d from wal replay) from %s",

@@ -67,15 +67,21 @@ class BlockPolicy:
 class Submission:
     """Handle for one ordered tx. `result()` drives block cutting until
     the tx is final — under group commit any waiter may end up committing
-    the block that contains it."""
+    the block that contains it. Carries the tx's trace context (captured
+    at enqueue) so block-commit work done by WHICHEVER thread wins the
+    commit race still lands in the submitting tx's trace."""
 
-    __slots__ = ("request", "event", "_done", "_orderer")
+    __slots__ = ("request", "event", "_done", "_orderer", "trace",
+                 "enqueued_at", "enqueued_unix")
 
     def __init__(self, orderer: Optional["Orderer"], request: TokenRequest):
         self.request = request
         self.event = None  # FinalityEvent once resolved
         self._done = threading.Event()
         self._orderer = orderer
+        self.trace = None  # TraceContext captured at enqueue
+        self.enqueued_at = 0.0  # monotonic, for queue-wait timing
+        self.enqueued_unix = 0.0
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -83,6 +89,10 @@ class Submission:
     def _resolve(self, event) -> None:
         self.event = event
         self._done.set()
+        mx.flight(
+            "finality", trace=self.trace,
+            tx=event.tx_id, status=event.status.value,
+        )
 
     def result(self, timeout: Optional[float] = None):
         """Block (driving commits as needed) until this tx has finality."""
@@ -112,9 +122,13 @@ class Orderer:
 
     def enqueue(self, request: TokenRequest) -> Submission:
         sub = Submission(self, request)
+        sub.trace = mx.current_trace()
+        sub.enqueued_at = time.monotonic()
+        sub.enqueued_unix = time.time()
         with self._mutex:
             self._pending.append(sub)
         mx.counter("ledger.ordering.enqueued").inc()
+        mx.flight("submit", trace=sub.trace, tx=request.anchor)
         return sub
 
     def pending(self) -> int:
@@ -127,7 +141,10 @@ class Orderer:
         faults.fire("orderer.cut")
         with self._mutex:
             n = min(len(self._pending), max(1, self.policy.max_block_txs))
-            return [self._pending.popleft() for _ in range(n)]
+            batch = [self._pending.popleft() for _ in range(n)]
+        if batch:
+            mx.flight("block.cut", txs=len(batch))
+        return batch
 
     # ------------------------------------------------------------ drive
 
@@ -201,14 +218,24 @@ class BlockValidationPipeline:
         self.policy = policy
 
     def proof_verdicts(
-        self, requests: Sequence[TokenRequest]
+        self, requests: Sequence[TokenRequest],
+        timings: Optional[dict] = None,
     ) -> Dict[int, Dict[int, bool]]:
+        """`timings`, when passed, is filled with the critical-path
+        split of this call: `grouping_s` (plan + same-shape grouping)
+        and `device_verify_s` (time inside batched verify calls,
+        including failed ones that degraded to host)."""
+        if timings is None:
+            timings = {}
+        timings.setdefault("grouping_s", 0.0)
+        timings.setdefault("device_verify_s", 0.0)
         if not self.policy.use_batched:
             return {}
         driver = self.validator.driver
         plan = getattr(driver, "transfer_batch_plan", None)
         if plan is None:
             return {}
+        t0 = time.monotonic()
         groups: Dict[tuple, List[Tuple[int, int, tuple]]] = {}
         for ti, req in enumerate(requests):
             for ri, rec in enumerate(req.transfers):
@@ -217,6 +244,7 @@ class BlockValidationPipeline:
                     continue
                 shape, row = p
                 groups.setdefault(shape, []).append((ti, ri, row))
+        timings["grouping_s"] = time.monotonic() - t0
 
         verdicts: Dict[int, Dict[int, bool]] = {}
         verifier = None
@@ -231,9 +259,11 @@ class BlockValidationPipeline:
                     # OOM building tables) degrade to host validation,
                     # same as verify failures — never fail a block
                     mx.counter("ledger.block.batch_errors").inc()
-                    return {}
+                    mx.flight("verify.host_fallback", reason="construct")
+                    return verdicts
                 if verifier is None:
-                    return {}
+                    return verdicts
+            tg = time.monotonic()
             try:
                 with mx.span(
                     "ledger.block.batch_verify", shape=str(shape), txs=len(rows)
@@ -246,7 +276,16 @@ class BlockValidationPipeline:
                 # the host plane re-verifies these rows; never fail a block
                 # on a device-plane error
                 mx.counter("ledger.block.batch_errors").inc()
+                mx.flight(
+                    "verify.host_fallback", shape=str(shape), txs=len(rows)
+                )
                 continue
+            finally:
+                timings["device_verify_s"] += time.monotonic() - tg
+            mx.flight(
+                "verify.device", shape=str(shape), txs=len(rows),
+                ok=int(sum(1 for g in ok if g)),
+            )
             for (ti, ri, _), good in zip(rows, ok):
                 verdicts.setdefault(ti, {})[ri] = bool(good)
         return verdicts

@@ -192,25 +192,16 @@ class LedgerServer:
 
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
+        # trace extraction: adopt the client's trace context so server
+        # spans (dispatch, orderer, validate, WAL) stitch into ONE trace
+        ctx = (
+            mx.TraceContext.from_wire(msg.get("trace"))
+            if isinstance(msg, dict) else None
+        )
         try:
-            if op == "submit":
-                ev = self.network.submit(bytes.fromhex(msg["request"]))
-                return {"ok": True, "status": ev.status.value, "message": ev.message,
-                        "tx_id": ev.tx_id}
-            if op == "resolve":
-                raw = self.network.resolve_input(ID(msg["tx_id"], msg["index"]))
-                return {"ok": True, "output": raw.hex()}
-            if op == "exists":
-                return {"ok": True, "exists": self.network.exists(ID(msg["tx_id"], msg["index"]))}
-            if op == "status":
-                ev = self.network.status(msg["tx_id"])
-                if ev is None:
-                    return {"ok": True, "status": None}
-                return {"ok": True, "status": ev.status.value, "message": ev.message}
-            if op == "height":
-                return {"ok": True, "height": self.network.height()}
-            return {"ok": False, "error": f"unknown op [{op}]",
-                    "error_class": "UnknownOp"}
+            with mx.use_trace(ctx):
+                with mx.span("remote.server.dispatch", op=op):
+                    return self._dispatch_op(op, msg)
         except ValidationError as e:
             return {"ok": False, "validation_error": str(e)}
         except Exception as e:  # defensive: never kill the server loop —
@@ -220,6 +211,61 @@ class LedgerServer:
             logger.exception("ledger server: op %s failed", op)
             return {"ok": False, "error": f"{type(e).__name__}: {e}",
                     "error_class": type(e).__name__}
+
+    def _dispatch_op(self, op: str, msg: dict) -> dict:
+        if op == "submit":
+            ev = self.network.submit(bytes.fromhex(msg["request"]))
+            # `transient` must cross the wire: a transient internal
+            # fault is retry-safe (the ledger records no verdict), a
+            # real rejection is final — remote callers need the same
+            # distinction local ones get
+            return {"ok": True, "status": ev.status.value, "message": ev.message,
+                    "tx_id": ev.tx_id, "transient": ev.transient}
+        if op == "submit_many":
+            # deterministic multi-tx blocks over the wire: enqueue every
+            # request (each under ITS OWN extracted trace context), then
+            # cut + commit in arrival order — server half of
+            # `RemoteNetwork.submit_many`
+            # decode EVERY request before enqueuing ANY: a malformed
+            # entry must fail the whole batch up front — enqueue-then-
+            # fail would strand already-accepted txs in the ordering
+            # queue (silently committed by later traffic, or never)
+            # while the client was told the batch failed. The parsed
+            # requests are handed straight to the ledger (no re-parse).
+            parsed = [
+                TokenRequest.from_bytes(bytes.fromhex(h))
+                for h in msg["requests"]
+            ]
+            # pad/truncate the trace list to the request list: a length
+            # mismatch from a buggy client must never drop requests
+            # (zip would silently truncate the batch)
+            traces = list(msg.get("traces") or ())[: len(parsed)]
+            traces += [None] * (len(parsed) - len(traces))
+            subs = []
+            for request, wire in zip(parsed, traces):
+                with mx.use_trace(mx.TraceContext.from_wire(wire)):
+                    subs.append(self.network.submit_request(request))
+            self.network.flush()
+            events = [s.result() for s in subs]
+            return {"ok": True, "events": [
+                {"tx_id": e.tx_id, "status": e.status.value,
+                 "message": e.message, "transient": e.transient}
+                for e in events
+            ]}
+        if op == "resolve":
+            raw = self.network.resolve_input(ID(msg["tx_id"], msg["index"]))
+            return {"ok": True, "output": raw.hex()}
+        if op == "exists":
+            return {"ok": True, "exists": self.network.exists(ID(msg["tx_id"], msg["index"]))}
+        if op == "status":
+            ev = self.network.status(msg["tx_id"])
+            if ev is None:
+                return {"ok": True, "status": None}
+            return {"ok": True, "status": ev.status.value, "message": ev.message}
+        if op == "height":
+            return {"ok": True, "height": self.network.height()}
+        return {"ok": False, "error": f"unknown op [{op}]",
+                "error_class": "UnknownOp"}
 
 
 class RemoteNetwork:
@@ -274,7 +320,12 @@ class RemoteNetwork:
         """One request/response over the pooled connection. Any transport
         failure closes the socket (the next call re-dials) and raises
         ConnectionError/OSError; server-side failures raise typed
-        ValidationError/RemoteError and keep the connection."""
+        ValidationError/RemoteError and keep the connection. The active
+        trace context is injected into the request frame so server-side
+        spans stitch into the caller's trace."""
+        ctx = mx.current_trace()
+        if ctx is not None:
+            msg["trace"] = ctx.to_wire()
         with self._lock:
             self._connect_locked()
             try:
@@ -313,6 +364,7 @@ class RemoteNetwork:
                 if attempt < self.retries:
                     mx.counter(f"remote.retry.{op}").inc()
                     mx.counter("remote.retry.attempts").inc()
+                    mx.flight("retry", op=op, attempt=attempt)
                     self._backoff(attempt)
         mx.counter("remote.retry.exhausted").inc()
         raise ConnectionError(
@@ -326,7 +378,15 @@ class RemoteNetwork:
 
     def submit(self, request_bytes: bytes) -> FinalityEvent:
         request = TokenRequest.from_bytes(request_bytes)
-        event = self._submit_exactly_once(request.anchor, request_bytes)
+        # client half of the distributed trace: join the caller's trace
+        # (ttx) or start one, and carry it across the wire in the frame
+        ctx = mx.current_trace() or mx.new_trace()
+        with mx.use_trace(ctx):
+            with mx.span("remote.submit", tx=request.anchor):
+                mx.flight("submit", tx=request.anchor, remote=True)
+                event = self._submit_exactly_once(request.anchor, request_bytes)
+        if not event.trace_id:
+            event.trace_id = ctx.trace_id
         self._notify(event, request)
         return event
 
@@ -334,14 +394,19 @@ class RemoteNetwork:
         """Submit with at-most-once commit semantics across retries: on a
         dropped connection, consult `status(tx_id)` BEFORE resubmitting —
         the commit may have raced the disconnect. The ledger's in-flight
-        dedup covers the residual window where status is still empty."""
+        dedup covers the residual window where status is still empty.
+        Each wire attempt and each status-recovery probe is a child span
+        of the caller's `remote.submit`, so retries are visible in the
+        tx's stitched trace."""
         msg = {"op": "submit", "request": request_bytes.hex()}
         last: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             try:
-                resp = self._call(msg)
+                with mx.span("remote.submit.attempt", attempt=attempt):
+                    resp = self._call(msg)
                 return FinalityEvent(
-                    resp["tx_id"], TxStatus(resp["status"]), resp["message"]
+                    resp["tx_id"], TxStatus(resp["status"]), resp["message"],
+                    transient=resp.get("transient", False),
                 )
             except (ConnectionError, OSError) as e:
                 last = e
@@ -351,14 +416,17 @@ class RemoteNetwork:
                 # _call_idempotent)
                 mx.counter("remote.retry.submit").inc()
                 mx.counter("remote.retry.attempts").inc()
+                mx.flight("retry", op="submit", attempt=attempt, tx=tx_id)
                 self._backoff(attempt)
                 try:
-                    known = self.status(tx_id)
+                    with mx.span("remote.submit.recover", attempt=attempt):
+                        known = self.status(tx_id)
                 except (ConnectionError, OSError) as e2:
                     last = e2
                     continue
                 if known is not None:
                     mx.counter("remote.submit.recovered").inc()
+                    mx.flight("submit.recovered", tx=tx_id)
                     return known
                 # the ledger has never recorded this tx: resubmitting is
                 # safe (and dedup'd server-side regardless)
@@ -376,6 +444,51 @@ class RemoteNetwork:
         sub = Submission(None, TokenRequest.from_bytes(request_bytes))
         sub._resolve(event)
         return sub
+
+    def submit_many(self, requests_bytes: List[bytes]) -> List[FinalityEvent]:
+        """API parity with `Network.submit_many`: ship the whole batch in
+        ONE wire call; the server enqueues everything and cuts
+        deterministic blocks (`max_block_txs` txs each). Every request
+        gets its OWN trace context, injected alongside the batch
+        (`traces` field), so each tx's client leg, server orderer leg,
+        batched verify, WAL append and finality stitch into one
+        per-transaction trace. NOT retried on transport failure — a
+        multi-tx batch is not idempotent; callers needing exactly-once
+        semantics should use per-tx `submit`."""
+        requests = [TokenRequest.from_bytes(rb) for rb in requests_bytes]
+        ctxs = [mx.new_trace() for _ in requests]
+        for req, ctx in zip(requests, ctxs):
+            mx.flight("submit", trace=ctx, tx=req.anchor, remote=True)
+        t0 = time.time()
+        with mx.span("remote.submit_many", txs=len(requests)):
+            resp = self._call({
+                "op": "submit_many",
+                "requests": [rb.hex() for rb in requests_bytes],
+                "traces": [c.to_wire() for c in ctxs],
+            })
+        t1 = time.time()
+        rows = resp["events"]
+        if len(rows) != len(requests):
+            # a short (or long) reply means txs lost finality silently —
+            # surface the protocol violation instead of zip-truncating
+            raise RemoteError(
+                f"submit_many returned {len(rows)} events for "
+                f"{len(requests)} requests",
+                error_class="ProtocolError",
+            )
+        events: List[FinalityEvent] = []
+        for req, ctx, row in zip(requests, ctxs, rows):
+            event = FinalityEvent(
+                row["tx_id"], TxStatus(row["status"]), row.get("message", ""),
+                transient=row.get("transient", False),
+                trace_id=ctx.trace_id,
+            )
+            # per-tx client leg: each tx spent the whole batched wire
+            # call waiting client-side — record it in the tx's trace
+            mx.record_span("remote.submit", t0, t1, trace=ctx, tx=req.anchor)
+            self._notify(event, req)
+            events.append(event)
+        return events
 
     def resolve_input(self, token_id: ID) -> bytes:
         resp = self._call_idempotent(

@@ -165,6 +165,9 @@ class WriteAheadLog:
                 good = end
             if good < len(data):
                 mx.counter("wal.torn_tails").inc()
+                mx.flight(
+                    "wal.torn_tail", bytes=len(data) - good, records=len(out)
+                )
                 logger.warning(
                     "wal: discarding %d-byte torn tail of %s after %d good "
                     "records", len(data) - good, self.path, len(out),

@@ -28,6 +28,10 @@ class Transaction:
         self.request: TokenRequest = party.tms.new_request(self.tx_id)
         self._selected: List[ID] = []
         self._submission = None  # set by submit_async
+        # distributed trace for this tx's whole lifecycle: minted at
+        # assembly, active through endorse/order/finality, propagated
+        # across the network boundary by remote.py
+        self.trace = mx.new_trace()
 
     # ------------------------------------------------------------ assembly
 
@@ -35,7 +39,8 @@ class Transaction:
               recipients: Sequence[bytes], anonymous: bool = True) -> None:
         issuer = self.party.wallets.issuer_wallet(issuer_wallet_id)
         anonymous = anonymous and self.party.driver.supports_anonymous_issue
-        with mx.span("ttx.assemble", tx=self.tx_id, kind="issue"):
+        with mx.use_trace(self.trace), \
+                mx.span("ttx.assemble", tx=self.tx_id, kind="issue"):
             self.party.tms.add_issue(
                 self.request, issuer, token_type, values, recipients, anonymous
             )
@@ -46,7 +51,8 @@ class Transaction:
     def transfer(self, owner_wallet_id: str, token_type: str,
                  values: Sequence[int], recipients: Sequence[bytes]) -> None:
         """Select inputs, build the transfer (+change), record movements."""
-        with mx.span("ttx.assemble", tx=self.tx_id, kind="transfer"):
+        with mx.use_trace(self.trace), \
+                mx.span("ttx.assemble", tx=self.tx_id, kind="transfer"):
             self._transfer(owner_wallet_id, token_type, values, recipients)
 
     def _transfer(self, owner_wallet_id: str, token_type: str,
@@ -98,7 +104,7 @@ class Transaction:
         Reference ttx/collect.go + auditor.go: the request is audited
         BEFORE ordering; the auditor signature covers actions + metadata.
         """
-        with mx.span("ttx.endorse", tx=self.tx_id):
+        with mx.use_trace(self.trace), mx.span("ttx.endorse", tx=self.tx_id):
             self.party.tms.sign_transfers(self.request)
             self.party.tms.sign_issues(self.request)
             if auditor is not None:
@@ -110,7 +116,8 @@ class Transaction:
         """Order + wait for finality (reference ttx/ordering.go then
         finality.go, collapsed for the synchronous caller)."""
         mx.counter("ttx.submitted").inc()
-        with mx.span("ttx.order_and_finality", tx=self.tx_id):
+        with mx.use_trace(self.trace), \
+                mx.span("ttx.order_and_finality", tx=self.tx_id):
             event = self.party.network.submit(self.request.to_bytes())
         return self._after_finality(event)
 
@@ -120,7 +127,7 @@ class Transaction:
         block and ride the batched validation plane. Call `wait()` for
         the finality event."""
         mx.counter("ttx.submitted").inc()
-        with mx.span("ttx.order", tx=self.tx_id):
+        with mx.use_trace(self.trace), mx.span("ttx.order", tx=self.tx_id):
             self._submission = self.party.network.submit_async(
                 self.request.to_bytes()
             )
@@ -131,7 +138,7 @@ class Transaction:
         if this caller wins the orderer's race); raise on rejection."""
         if self._submission is None:
             raise RuntimeError(f"tx {self.tx_id} was never submitted")
-        with mx.span("ttx.finality", tx=self.tx_id):
+        with mx.use_trace(self.trace), mx.span("ttx.finality", tx=self.tx_id):
             event = self._submission.result(timeout)
         return self._after_finality(event)
 

@@ -116,6 +116,9 @@ def fire(site: str) -> None:
             f.remaining -= 1
         kind, delay_s, exc = f.kind, f.delay_s, f.exc
     mx.counter(f"faults.injected.{site}").inc()
+    # flight-record the firing with the ACTIVE trace id, so a chaos run
+    # can correlate each injected fault to the exact tx it hit
+    mx.flight("fault", site=site, fault_kind=kind)
     if kind == "delay":
         time.sleep(delay_s)
         return

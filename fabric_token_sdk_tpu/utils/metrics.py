@@ -1,4 +1,5 @@
-"""Metrics core: counters, gauges, histograms, span trees, export plane.
+"""Metrics core: counters, gauges, histograms, span trees, trace
+contexts, a crash flight recorder, and the export plane.
 
 Reference parity: fabric-smart-client threads a metrics provider
 (`platform/view/services/metrics`) and `flogging` through every token
@@ -15,6 +16,19 @@ Design:
   unmeasurable next to any group operation — while **spans and
   heartbeats are env-gated** (``FTS_METRICS=1``, or ``enable()``):
   the disabled ``span()`` fast path is a single global check.
+* **Trace contexts** (Dapper/OpenTelemetry style): ``new_trace()`` mints
+  a ``trace_id``; ``use_trace(ctx)`` activates it for the thread; spans
+  opened under it carry ``trace_id``/``span_id``/``parent_span_id`` and
+  a wall-clock ``start_unix``, so per-transaction causal timelines can
+  be stitched across threads AND processes (``TraceContext.to_wire`` /
+  ``from_wire`` is the propagation format `remote.py` injects into
+  request frames). ``cmd/ftstrace.py`` assembles the timelines.
+* **Flight recorder** (``FLIGHT`` / ``flight(kind, ...)``): an always-on
+  bounded ring of structured lifecycle events (submits, block cuts,
+  verify decisions, WAL appends, faults, retries, compile/cache events),
+  each tagged with the active trace id. Dumped to a ``*.flight.json``
+  sidecar alongside every metrics sidecar flush — an rc=124 death
+  leaves *what was happening*, not just final counter values.
 * Export: ``to_json()`` (the ``*.metrics.json`` sidecar format read by
   ``cmd/ftsmetrics.py``) and ``to_prometheus()`` (text exposition
   format, counters/gauges/histograms only).
@@ -25,12 +39,13 @@ Design:
   from a watchdog thread about to ``os._exit``).
 * ``Heartbeat`` emits phase-stamped progress lines to stderr from a
   daemon thread (``[fts] phase=compile elapsed=134s``) and records the
-  phase timeline in the registry.
+  phase timeline in the registry (and the flight recorder).
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
 import json
 import os
@@ -179,6 +194,11 @@ class Span:
     end: Optional[float] = None
     attrs: dict = field(default_factory=dict)
     children: List["Span"] = field(default_factory=list)
+    # trace plane: wall-clock anchor + ids for cross-process stitching
+    start_unix: float = 0.0
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
     @property
     def duration(self) -> float:
@@ -186,6 +206,14 @@ class Span:
 
     def to_dict(self) -> dict:
         d = {"name": self.name, "duration_s": round(self.duration, 6)}
+        if self.start_unix:
+            d["start_unix"] = round(self.start_unix, 6)
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        if self.span_id:
+            d["span_id"] = self.span_id
+        if self.parent_span_id:
+            d["parent_span_id"] = self.parent_span_id
         if self.attrs:
             d["attrs"] = {k: _jsonable(v) for k, v in self.attrs.items()}
         if self.children:
@@ -196,25 +224,116 @@ class Span:
 def _jsonable(v):
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
     return str(v)
 
 
 _tls = threading.local()
 
 
+# ------------------------------------------------------------ trace context
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclass
+class TraceContext:
+    """Propagatable trace identity (Dapper / OpenTelemetry trace-context
+    style): ``trace_id`` names one end-to-end transaction; ``span_id``
+    is the id new child spans adopt as their parent. ``to_wire()`` /
+    ``from_wire()`` is the cross-process format `remote.py` carries in
+    request frames."""
+
+    trace_id: str
+    span_id: str = ""
+
+    def to_wire(self) -> list:
+        return [self.trace_id, self.span_id]
+
+    @classmethod
+    def from_wire(cls, wire) -> Optional["TraceContext"]:
+        if not wire:
+            return None
+        try:
+            return cls(str(wire[0]), str(wire[1]) if len(wire) > 1 else "")
+        except (TypeError, KeyError, IndexError):
+            return None
+
+
+def new_trace() -> TraceContext:
+    """Mint a fresh trace context. Always available — trace ids tag
+    flight-recorder events even when span recording is disabled."""
+    REGISTRY.counter("trace.traces").inc()
+    return TraceContext(_new_id(8), _new_id(4))
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The thread's active trace context: derived from the innermost
+    open span when it belongs to the `use_trace`-activated trace (so new
+    children nest correctly), else the activation itself — an explicit
+    `use_trace` of a DIFFERENT trace overrides enclosing spans. That
+    override is what lets a group-commit thread attribute per-tx work to
+    each submitting tx's trace while its own spans stay open."""
+    ctx = getattr(_tls, "trace", None)
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        s = stack[-1]
+        if s.trace_id and (ctx is None or ctx.trace_id == s.trace_id):
+            return TraceContext(s.trace_id, s.span_id)
+    return ctx
+
+
+@contextlib.contextmanager
+def use_trace(ctx: Optional[TraceContext]):
+    """Activate `ctx` for this thread (None = no-op): spans opened and
+    flight events recorded inside join the trace."""
+    if ctx is None:
+        yield None
+        return
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.trace = prev
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs):
-    """Timed span; nests into the per-thread open span, auto-observes its
-    duration into histogram ``<name>.seconds``. No-op (yields None) when
-    metrics are disabled."""
+    """Timed span; nests into the per-thread open span, inherits the
+    active trace context, auto-observes its duration into histogram
+    ``<name>.seconds``. No-op (yields None) when metrics are disabled."""
     if not _enabled:
         yield None
         return
     s = Span(name, time.monotonic(), attrs=attrs)
+    s.start_unix = time.time()
+    s.span_id = _new_id(4)
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
     parent = stack[-1] if stack else None
+    # trace linkage: inherit from the parent span when it belongs to the
+    # same trace as the active `use_trace` context (or no context is
+    # active); an explicitly activated DIFFERENT trace wins — the
+    # group-commit thread validates other submitters' txs under their
+    # traces while its own (traceless or other-trace) spans stay open
+    ctx = getattr(_tls, "trace", None)
+    if parent is not None and parent.trace_id and (
+        ctx is None or ctx.trace_id == parent.trace_id
+    ):
+        s.trace_id = parent.trace_id
+        s.parent_span_id = parent.span_id
+    elif ctx is not None:
+        s.trace_id = ctx.trace_id
+        s.parent_span_id = ctx.span_id
+    if s.trace_id:
+        REGISTRY.counter("trace.spans").inc()
     stack.append(s)
     try:
         yield s
@@ -226,6 +345,25 @@ def span(name: str, **attrs):
         else:
             REGISTRY.record_span_root(s)
         REGISTRY.histogram(name + ".seconds").observe(s.duration)
+
+
+def record_span(name: str, start_unix: float, end_unix: float,
+                trace: Optional[TraceContext] = None, **attrs) -> Optional[Span]:
+    """Record an already-timed root span (for work measured across
+    threads — e.g. a submission's queue wait stamped at block cut, or
+    the per-tx client leg of a batched wire call). Gated like `span`."""
+    if not _enabled:
+        return None
+    s = Span(name, 0.0, end=max(0.0, end_unix - start_unix), attrs=attrs)
+    s.start_unix = start_unix
+    s.span_id = _new_id(4)
+    if trace is not None:
+        s.trace_id = trace.trace_id
+        s.parent_span_id = trace.span_id
+        REGISTRY.counter("trace.spans").inc()
+    REGISTRY.record_span_root(s)
+    REGISTRY.histogram(name + ".seconds").observe(s.duration)
+    return s
 
 
 # ------------------------------------------------------------ registry
@@ -345,6 +483,7 @@ class Registry:
                 self._lock.release()
         return {
             "meta": meta,
+            "pid": os.getpid(),
             "flushed_unix": round(time.time(), 3),
             "phases": phases,
             "counters": counters,
@@ -472,6 +611,9 @@ class Heartbeat:
         with self._lock:
             prev, prev_start, prev_attrs = self._phase, self._phase_start, self._attrs
             self._phase, self._phase_start, self._attrs = name, now, attrs
+        # lifecycle events are always flight-recorded (the ring is how a
+        # killed run answers "which phase was live, after what history")
+        FLIGHT.record("phase", phase=name, **attrs)
         if _enabled:  # phases are gated like spans/heartbeat lines
             REGISTRY.record_phase(prev, prev_start, now, **prev_attrs)
             REGISTRY.gauge("progress.phase_start_unix").set(now)
@@ -515,6 +657,123 @@ class Heartbeat:
             REGISTRY.record_phase(phase, phase_start, time.time(), **attrs)
 
 
+# ------------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring buffer of structured lifecycle events — the crash
+    flight recorder. Always on (recording is one lock + deque append on
+    rare events: submits, block cuts, verify decisions, WAL appends,
+    faults, retries, compiles), so an rc=124 death leaves a causal trail
+    of *what was happening*, not just final counter values. The ring is
+    dumped to a ``*.flight.json`` sidecar by every `flush_sidecar` (and
+    on demand via `dump`); capacity comes from ``FTS_FLIGHT_EVENTS``
+    (default 1024) — sustained load evicts the oldest events only."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("FTS_FLIGHT_EVENTS", "1024"))
+            except ValueError:
+                capacity = 1024
+        self.capacity = max(1, capacity)
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, trace: Optional[TraceContext] = None,
+               **attrs) -> None:
+        ctx = trace if trace is not None else current_trace()
+        evt = {"ts": round(time.time(), 6), "kind": kind}
+        if ctx is not None:
+            evt["trace_id"] = ctx.trace_id
+        for k, v in attrs.items():
+            if v is not None:
+                evt[k] = _jsonable(v)
+        # timed acquire: tail()/dump() may run under a signal handler
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            self._ring.append(evt)
+        finally:
+            if acquired:
+                self._lock.release()
+        REGISTRY.counter("flight.events").inc()
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        acquired = self._lock.acquire(timeout=1.0)
+        try:
+            if acquired:
+                events = list(self._ring)
+            else:
+                # unlocked best-effort read (signal-handler path, lock
+                # held by the interrupted thread): a concurrent append
+                # can invalidate iteration — retry, then settle for an
+                # empty tail rather than raising out of the flush
+                events = []
+                for _ in range(3):
+                    try:
+                        events = list(self._ring)
+                        break
+                    except RuntimeError:
+                        continue
+        finally:
+            if acquired:
+                self._lock.release()
+        return events if n is None else events[-n:]
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def dump(self, path: str) -> Optional[str]:
+        """Write the ring to `path` (atomic rename); returns the path,
+        or None on failure. Safe under signal handlers — NEVER raises
+        (the SIGTERM flush must not die building its own payload)."""
+        try:
+            payload = json.dumps(
+                {
+                    "dumped_unix": round(time.time(), 3),
+                    "pid": os.getpid(),
+                    "capacity": self.capacity,
+                    "events": self.tail(),
+                }
+            )
+        except Exception:
+            return None
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        REGISTRY.counter("flight.dumps").inc()
+        return path
+
+
+FLIGHT = FlightRecorder()
+
+
+def flight(kind: str, trace: Optional[TraceContext] = None, **attrs) -> None:
+    """Record one flight-recorder event (always on; tags the active —
+    or explicitly passed — trace context)."""
+    FLIGHT.record(kind, trace=trace, **attrs)
+
+
+def flight_sidecar_path(metrics_path: str) -> str:
+    """Derive the flight sidecar path from a metrics sidecar path
+    (``X.metrics.json`` -> ``X.flight.json``)."""
+    if metrics_path.endswith(".metrics.json"):
+        return metrics_path[: -len(".metrics.json")] + ".flight.json"
+    return metrics_path + ".flight.json"
+
+
 # ------------------------------------------------------------ sidecar
 
 
@@ -524,10 +783,11 @@ _sidecar_installed = False
 
 
 def flush_sidecar(path: Optional[str] = None) -> Optional[str]:
-    """Write the registry snapshot to the sidecar JSON (atomic rename).
+    """Write the registry snapshot to the sidecar JSON (atomic rename)
+    and the flight-recorder ring to the derived ``*.flight.json``.
 
     Safe to call from signal handlers and watchdog threads; returns the
-    path written, or None if no path is configured.
+    metrics path written, or None if no path is configured.
     """
     p = path or _sidecar_path
     if not p:
@@ -549,6 +809,7 @@ def flush_sidecar(path: Optional[str] = None) -> Optional[str]:
     finally:
         if acquired:
             _sidecar_lock.release()
+    FLIGHT.dump(flight_sidecar_path(p))
     return p
 
 

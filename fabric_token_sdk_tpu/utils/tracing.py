@@ -4,20 +4,31 @@ Historical note: this module began as a standalone 70-line tracer wired
 into exactly one call site; it is now a thin compatibility adapter so
 existing ``tracer.span(...)`` / ``tracer.count(...)`` call sites feed the
 process-wide metrics registry (one export plane, one enable switch —
-``FTS_METRICS=1``). New code should import ``utils.metrics`` directly.
+``FTS_METRICS=1``). There is exactly ONE span model: `metrics.Span`,
+which since the distributed-tracing plane landed also carries
+``trace_id`` / ``span_id`` / ``parent_span_id`` — this facade delegates
+to that trace-context API rather than keeping any parallel ID scheme
+(``Span``, ``TraceContext``, ``new_trace``, ``current_trace``,
+``use_trace`` are re-exported below). New code should import
+``utils.metrics`` directly.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict
+from typing import Dict, Optional
 
 from . import metrics
 
 logger = logging.getLogger("fts_tpu")
 
-# re-exported for callers that used the old dataclass directly
+# re-exported for callers that used the old dataclass directly, and for
+# the trace-context API (one span model, one id scheme — metrics.py's)
 Span = metrics.Span
+TraceContext = metrics.TraceContext
+new_trace = metrics.new_trace
+current_trace = metrics.current_trace
+use_trace = metrics.use_trace
 
 
 class Tracer:
@@ -36,6 +47,9 @@ class Tracer:
 
     def count(self, name: str, n: int = 1) -> None:
         metrics.counter(name).inc(n)
+
+    def current_trace(self) -> Optional[metrics.TraceContext]:
+        return metrics.current_trace()
 
     def summary(self) -> Dict[str, dict]:
         return metrics.REGISTRY.span_summary()

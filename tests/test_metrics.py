@@ -377,6 +377,13 @@ def test_bench_sidecar_flushed_on_sigterm(tmp_path):
     assert d["meta"]["killed_by_signal"] == int(signal.SIGTERM)
     assert d["phases"], "no phase timeline recorded"
     assert "counters" in d and "histograms" in d
+    # the flight recorder shipped its ring alongside the metrics sidecar:
+    # the lifecycle trail (phase events at minimum) survives the kill
+    flight = sidecar[: -len(".metrics.json")] + ".flight.json"
+    assert os.path.exists(flight), "SIGTERM did not dump the flight ring"
+    fd = json.loads(open(flight).read())
+    assert fd["events"], "flight ring dumped empty"
+    assert any(e["kind"] == "phase" for e in fd["events"])
     # exit status must still reflect the kill (handler chains to default)
     assert proc.returncode != 0
 
@@ -411,3 +418,14 @@ def test_bench_sidecar_flushed_on_deadline(tmp_path):
     assert "progress.phase" in d["meta"]  # the phase that was live at kill
     # compile/cache counters exist in the dump (may be zero this early)
     assert isinstance(d["counters"], dict)
+    # ISSUE acceptance: a deadline-killed bench leaves a flight-record
+    # sidecar whose ring ends with the watchdog's own death marker, after
+    # the lifecycle events (phases at minimum) that led up to it
+    flight = sidecar[: -len(".metrics.json")] + ".flight.json"
+    assert os.path.exists(flight), "deadline did not dump the flight ring"
+    fd = json.loads(open(flight).read())
+    kinds = [e["kind"] for e in fd["events"]]
+    assert "phase" in kinds
+    assert "bench.deadline" in kinds
+    dl = [e for e in fd["events"] if e["kind"] == "bench.deadline"][-1]
+    assert dl["deadline_s"] == 8.0 and "phase" in dl

@@ -1,0 +1,195 @@
+"""CI drift check: the metric taxonomy in docs/OBSERVABILITY.md cannot
+rot. Every counter/gauge/histogram/span/flight-event name emitted by the
+codebase must appear in the doc, and every metric-shaped name the doc
+claims must still exist in the code — so removed metrics get pruned and
+new metrics get documented in the same PR that touches them.
+"""
+
+import os
+import re
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DOC_PATH = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+# sources that emit metrics (tests excluded: scratch names are fine there)
+SRC_DIRS = ("fabric_token_sdk_tpu", "cmd")
+SRC_FILES = ("bench.py", "__graft_entry__.py")
+
+# literal first-arg instrument/span/flight call sites; f-strings keep
+# their "{placeholder}" tail, normalized to a prefix below
+_PATTERNS = (
+    ("counter", re.compile(r'\.counter\(\s*f?"([^"]+)"')),
+    ("gauge", re.compile(r'\.gauge\(\s*f?"([^"]+)"')),
+    ("histogram", re.compile(r'\.histogram\(\s*f?"([^"]+)"')),
+    ("histogram", re.compile(r'\.timed\(\s*f?"([^"]+)"')),
+    ("span", re.compile(r'\.span\(\s*f?"([^"]+)"')),
+    ("span", re.compile(r'\.record_span\(\s*f?"([^"]+)"')),
+    ("span", re.compile(r'_spanned\(\s*f?"([^"]+)"')),
+    ("flight", re.compile(r'\.flight\(\s*f?"([^"]+)"')),
+    ("flight", re.compile(r'FLIGHT\.record\(\s*f?"([^"]+)"')),
+)
+
+# doc tokens that look metric-shaped but are file/module references
+_DOC_SKIP_SUFFIXES = (".py", ".pyc", ".c", ".cc", ".md", ".json", ".go")
+_DOC_SKIP = {"jax.monitoring"}
+
+
+def _source_files():
+    for d in SRC_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(REPO, d)):
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+    for f in SRC_FILES:
+        yield os.path.join(REPO, f)
+
+
+def _emitted():
+    """{(kind, name)}: every literal metric/span/flight name in the code.
+    f-string names are cut at the first '{' and marked as prefixes by
+    their trailing '.'."""
+    out = set()
+    corpus = []
+    for path in _source_files():
+        with open(path) as fh:
+            text = fh.read()
+        corpus.append(text)
+        for kind, pat in _PATTERNS:
+            for name in pat.findall(text):
+                out.add((kind, name.split("{")[0]))
+    return out, "\n".join(corpus)
+
+
+def _expand_doc_token(token):
+    """Expand one backticked doc token into concrete names: `{a,b}`
+    groups, trailing `x/y/z` and `x|y` alternations over the last dotted
+    segment. Tokens containing `<placeholder>` become prefixes (cut at
+    '<')."""
+    m = re.search(r"\{([^}]*,[^}]*)\}", token)
+    if m:
+        out = []
+        for alt in m.group(1).split(","):
+            out.extend(_expand_doc_token(token[: m.start()] + alt + token[m.end():]))
+        return out
+    names = [token]
+    for sep in ("/", "|"):
+        new = []
+        for t in names:
+            if sep in t:
+                parts = t.split(sep)
+                head = parts[0]
+                prefix = head.rsplit(".", 1)[0] + "." if "." in head else ""
+                new.append(head)
+                new.extend(prefix + p for p in parts[1:])
+            else:
+                new.append(t)
+        names = new
+    return names
+
+
+_METRIC_SHAPE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\.?$")
+
+
+def _doc_names(doc_text):
+    """(exact names, prefix names) the doc claims, from backticked
+    metric-shaped tokens."""
+    exact, prefixes = set(), set()
+    for token in re.findall(r"`([^`\n]+)`", doc_text):
+        if token.startswith("<"):
+            continue
+        if token.endswith(_DOC_SKIP_SUFFIXES) or token in _DOC_SKIP:
+            continue
+        for name in _expand_doc_token(token):
+            cut = name.split("<")[0]
+            is_prefix = cut != name or name.endswith(".")
+            cut = cut.rstrip(".") + ("." if is_prefix else "")
+            if not _METRIC_SHAPE.match(cut.rstrip(".") + (".x" if is_prefix else "")):
+                if not (is_prefix and _METRIC_SHAPE.match(cut + "x")):
+                    continue
+            (prefixes if is_prefix else exact).add(cut)
+    return exact, prefixes
+
+
+def _doc_flight_kinds(doc_text):
+    """Event kinds claimed by the flight-recorder taxonomy table (first
+    column of each row, between the section heading and the next one)."""
+    m = re.search(
+        r"## Flight-recorder event taxonomy(.*?)\n## ", doc_text, re.S
+    )
+    assert m, "docs/OBSERVABILITY.md lost its flight-recorder taxonomy section"
+    return set(
+        re.findall(r"^\|\s*`([a-z][a-z0-9_.]*)`\s*\|", m.group(1), re.M)
+    )
+
+
+def test_every_emitted_metric_is_documented():
+    emitted, _corpus = _emitted()
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    doc_flight = _doc_flight_kinds(doc)
+    exact, prefixes = _doc_names(doc)
+
+    def documented(name):
+        if name.endswith("."):
+            # emitted prefix (f-string name): any doc prefix or exact
+            # name under it counts as documentation
+            return any(
+                d.startswith(name) or name.startswith(d) for d in prefixes
+            ) or any(d.startswith(name) for d in exact)
+        if name in exact:
+            return True
+        return any(name.startswith(p) for p in prefixes)
+
+    missing = []
+    for kind, name in sorted(emitted):
+        base = name.rstrip(".") if not name.endswith(".") else name
+        if kind == "flight":
+            if base not in doc_flight:
+                missing.append(f"flight event `{base}`")
+            continue
+        # spans are documented either by span name or their auto-fed
+        # `<name>.seconds` histogram
+        needles = [base]
+        if kind == "span":
+            needles.append(base + ".seconds")
+        if not any(documented(n) for n in needles):
+            missing.append(f"{kind} `{base}`")
+    assert not missing, (
+        "metric names emitted but absent from docs/OBSERVABILITY.md "
+        "(document them in the taxonomy):\n  " + "\n  ".join(missing)
+    )
+
+
+def test_every_documented_metric_still_exists():
+    emitted, corpus = _emitted()
+    emitted_names = {name for _kind, name in emitted}
+    emitted_exact = {n for n in emitted_names if not n.endswith(".")}
+    emitted_prefixes = {n for n in emitted_names if n.endswith(".")}
+    # span names also exist as `<name>.seconds` histograms
+    for kind, name in list(emitted):
+        if kind == "span" and not name.endswith("."):
+            emitted_exact.add(name + ".seconds")
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    exact, prefixes = _doc_names(doc)
+    exact |= _doc_flight_kinds(doc)
+
+    def exists(name):
+        base = name.rstrip(".")
+        if base in emitted_exact or name in emitted_prefixes:
+            return True
+        if any(base.startswith(p) for p in emitted_prefixes):
+            return True
+        if name.endswith(".") and any(
+            e.startswith(name) for e in emitted_exact
+        ):
+            return True
+        # dynamically-built names (the jax.* monitoring plane) must at
+        # least appear verbatim somewhere in the source tree
+        return base in corpus
+
+    stale = sorted(n for n in exact | prefixes if not exists(n))
+    assert not stale, (
+        "docs/OBSERVABILITY.md documents metrics no longer emitted "
+        "anywhere (prune or fix them):\n  " + "\n  ".join(stale)
+    )

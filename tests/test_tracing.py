@@ -1,0 +1,382 @@
+"""Distributed tracing plane: trace contexts, the crash flight recorder,
+cross-process propagation over a real socket (including retry-after-drop
+child spans), fault/trace correlation, and the `ftstrace` assembly CLI.
+
+Acceptance: an 8-tx zkatdlog block submitted through `submit_many` over
+`RemoteNetwork` yields one stitched trace per tx spanning client submit
+-> server orderer -> batched device verify -> WAL append -> finality,
+with a per-block critical-path breakdown.
+"""
+
+import json
+import os
+import random
+import sys
+import threading
+
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+from fabric_token_sdk_tpu.services.network import BlockPolicy, Network, TxStatus
+from fabric_token_sdk_tpu.services.network.remote import LedgerServer, RemoteNetwork
+from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture
+def enabled():
+    was = mx.enabled()
+    mx.enable(True)
+    try:
+        yield
+    finally:
+        mx.enable(was)
+
+
+@pytest.fixture(scope="module")
+def zk_pp():
+    return setup(base=4, exponent=2, rng=random.Random(0xF75))
+
+
+def _ftstrace():
+    sys.path.insert(0, os.path.join(REPO, "cmd"))
+    try:
+        import ftstrace
+    finally:
+        sys.path.pop(0)
+    return ftstrace
+
+
+def _trace_spans(trace_id):
+    """Every recorded span (root or child) carrying `trace_id`."""
+    out = []
+
+    def walk(d):
+        if d.get("trace_id") == trace_id:
+            out.append(d)
+        for c in d.get("children", ()):
+            walk(c)
+
+    for root in mx.REGISTRY.snapshot()["spans"]:
+        walk(root)
+    return out
+
+
+# ------------------------------------------------------------ trace context
+
+
+def test_spans_inherit_active_trace(enabled):
+    ctx = mx.new_trace()
+    with mx.use_trace(ctx):
+        with mx.span("tr.outer") as outer:
+            with mx.span("tr.inner") as inner:
+                pass
+    assert outer.trace_id == ctx.trace_id
+    assert outer.parent_span_id == ctx.span_id
+    assert inner.trace_id == ctx.trace_id
+    assert inner.parent_span_id == outer.span_id
+    assert outer.span_id and outer.span_id != inner.span_id
+    d = outer.to_dict()
+    assert d["trace_id"] == ctx.trace_id
+    assert d["span_id"] == outer.span_id
+    assert d["start_unix"] > 0
+
+
+def test_explicit_trace_overrides_foreign_parent_span(enabled):
+    """The group-commit pattern: a thread with its OWN trace open
+    validates another tx under that tx's context — the explicit
+    `use_trace` must win over parent-span inheritance."""
+    mine, theirs = mx.new_trace(), mx.new_trace()
+    with mx.use_trace(mine):
+        with mx.span("tr.commit_loop") as outer:
+            assert mx.current_trace().trace_id == mine.trace_id
+            with mx.use_trace(theirs):
+                assert mx.current_trace().trace_id == theirs.trace_id
+                with mx.span("tr.validate_other") as child:
+                    pass
+            # restored after the override
+            assert mx.current_trace().trace_id == mine.trace_id
+    assert outer.trace_id == mine.trace_id
+    assert child.trace_id == theirs.trace_id
+    assert child.parent_span_id == theirs.span_id
+
+
+def test_trace_context_wire_round_trip():
+    ctx = mx.new_trace()
+    assert mx.TraceContext.from_wire(ctx.to_wire()) == ctx
+    assert mx.TraceContext.from_wire(None) is None
+    assert mx.TraceContext.from_wire([]) is None
+    assert mx.TraceContext.from_wire(["t-only"]) == mx.TraceContext("t-only", "")
+
+
+def test_record_span_lands_in_registry(enabled):
+    ctx = mx.new_trace()
+    s = mx.record_span("tr.manual", 100.0, 101.5, trace=ctx, tx="m1")
+    assert s.duration == pytest.approx(1.5)
+    assert s.trace_id == ctx.trace_id
+    found = [d for d in _trace_spans(ctx.trace_id) if d["name"] == "tr.manual"]
+    assert found and found[0]["attrs"]["tx"] == "m1"
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+def test_flight_ring_bounded_under_sustained_load():
+    """Concurrent writers can never grow the ring past capacity; the
+    newest events survive, the oldest are evicted."""
+    fr = mx.FlightRecorder(capacity=64)
+    threads = [
+        threading.Thread(
+            target=lambda k: [fr.record("tick", worker=k, i=i) for i in range(500)],
+            args=(k,),
+        )
+        for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = fr.tail()
+    assert len(events) == 64
+    assert len(fr) == 64
+    # the ring holds the TAIL of the stream: every surviving event is
+    # from the back half of some worker's sequence
+    assert all(e["i"] >= 500 - 64 for e in events)
+    fr.record("last", i=-1)
+    assert fr.tail(1)[0]["kind"] == "last"
+    assert len(fr) == 64
+
+
+def test_flight_event_tagged_with_active_trace():
+    ctx = mx.new_trace()
+    with mx.use_trace(ctx):
+        mx.flight("tr.tagged", detail=1)
+    evt = mx.FLIGHT.tail(1)[0]
+    assert evt["kind"] == "tr.tagged"
+    assert evt["trace_id"] == ctx.trace_id
+
+
+def test_fault_firing_correlates_to_trace():
+    """Satellite: an injected fault's flight event carries the trace id
+    of the tx it hit."""
+    ctx = mx.new_trace()
+    faults.arm("tr.fault_site", "error", count=1)
+    with mx.use_trace(ctx):
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("tr.fault_site")
+    evt = [e for e in mx.FLIGHT.tail() if e["kind"] == "fault"][-1]
+    assert evt["site"] == "tr.fault_site"
+    assert evt["trace_id"] == ctx.trace_id
+
+
+def test_flight_dump_and_ftstrace_tail(tmp_path, capsys):
+    fr = mx.FlightRecorder(capacity=16)
+    for i in range(5):
+        fr.record("dump.check", i=i)
+    path = str(tmp_path / "t.flight.json")
+    assert fr.dump(path) == path
+    doc = json.loads(open(path).read())
+    assert doc["capacity"] == 16
+    assert [e["i"] for e in doc["events"]] == list(range(5))
+    assert doc["pid"] == os.getpid()
+    ftstrace = _ftstrace()
+    assert ftstrace.tail(path, n=3) == 0
+    out = capsys.readouterr().out
+    assert "dump.check" in out and "i=4" in out
+    # -n bounds the rows: i=0 rolled out of the view
+    assert "i=0" not in out
+
+
+def test_flush_sidecar_also_dumps_flight(tmp_path):
+    mx.flight("sidecar.check")
+    p = str(tmp_path / "x.metrics.json")
+    assert mx.flush_sidecar(p) == p
+    flight = str(tmp_path / "x.flight.json")
+    assert os.path.exists(flight)
+    doc = json.loads(open(flight).read())
+    assert any(e["kind"] == "sidecar.check" for e in doc["events"])
+
+
+# ------------------------------------------------------------ remote propagation
+
+
+def _fab_remote_env(tmp_path=None, **client_kw):
+    pp = FabTokenPublicParams()
+    wal = str(tmp_path / "ledger.wal") if tmp_path is not None else None
+    net = Network(
+        RequestValidator(FabTokenDriver(pp)),
+        policy=BlockPolicy(max_block_txs=16, min_batch=1),
+        wal_path=wal,
+    )
+    server = LedgerServer(network=net).start()
+    client = RemoteNetwork(server.address, **client_kw)
+    issuer_p = Party("issuer", FabTokenDriver(pp), client)
+    alice_p = Party("alice", FabTokenDriver(pp), client)
+    bob_p = Party("bob", FabTokenDriver(pp), client)
+    iw = issuer_p.new_issuer_wallet("issuer")
+    pp.add_issuer(iw.identity)
+    alice = alice_p.new_owner_wallet("alice", anonymous=False)
+    bob = bob_p.new_owner_wallet("bob", anonymous=False)
+    return server, client, issuer_p, alice_p, bob_p, alice, bob
+
+
+def test_remote_trace_propagation_with_retry_after_drop(enabled):
+    """Satellite acceptance: client span + server span share ONE trace id
+    across a real socket, and the retry after a dropped connection shows
+    up as a child span in the same trace."""
+    server, client, issuer_p, alice_p, bob_p, alice, bob = _fab_remote_env(
+        retries=3, backoff_s=0.001
+    )
+    try:
+        tx = Transaction(issuer_p, "tr-mint")
+        tx.issue("issuer", "USD", [9], [alice.recipient_identity()],
+                 anonymous=False)
+        tx.collect_endorsements(None)
+        # drop the client connection exactly once, mid-submit (after the
+        # request frame went out, before the response is read)
+        faults.arm("remote.recv", "drop", count=1)
+        event = tx.submit()
+    finally:
+        faults.clear()
+        server.stop()
+    assert event.status == TxStatus.VALID
+    assert event.trace_id == tx.trace.trace_id
+
+    spans = _trace_spans(tx.trace.trace_id)
+    names = [s["name"] for s in spans]
+    # client-side legs
+    assert "remote.submit" in names
+    # server-side legs, SAME trace id — propagated through the frame
+    assert "remote.server.dispatch" in names
+    assert "network.validate" in names
+    assert "orderer.queue" in names
+    # the drop is visible as retry work inside the trace: either a second
+    # wire attempt or a status-recovery probe (commit raced the drop)
+    attempts = [s for s in names if s in ("remote.submit.attempt",
+                                          "remote.submit.recover")]
+    assert len(attempts) >= 2, names
+    # the injected fault itself is flight-recorded WITH the trace id
+    fault_evts = [
+        e for e in mx.FLIGHT.tail()
+        if e["kind"] == "fault" and e.get("site") == "remote.recv"
+    ]
+    assert fault_evts and fault_evts[-1]["trace_id"] == tx.trace.trace_id
+
+
+# ------------------------------------------------------------ acceptance
+
+
+def test_8tx_zk_block_stitched_traces_over_remote(zk_pp, tmp_path, capsys,
+                                                  enabled):
+    """ISSUE acceptance: 8 same-shape zkatdlog transfers through
+    `RemoteNetwork.submit_many` ride ONE batched device verify inside one
+    block, and `ftstrace` assembles one stitched per-tx trace covering
+    client submit -> server orderer -> batched device verify -> WAL
+    append -> finality, plus the per-block critical-path breakdown."""
+    pp = zk_pp
+    wal_path = str(tmp_path / "zk-ledger.wal")
+    net = Network(
+        RequestValidator(ZKATDLogDriver(pp)),
+        policy=BlockPolicy(max_block_txs=16, min_batch=2),
+        wal_path=wal_path,
+    )
+    server = LedgerServer(network=net).start()
+    # generous socket timeout: the first batched verify in a process pays
+    # one-time stage-tile loads from the persistent cache (minutes on a
+    # small cold host), all inside ONE submit_many round trip
+    client = RemoteNetwork(server.address, timeout=600)
+    issuer_p = Party("issuer", ZKATDLogDriver(pp), client)
+    alice_p = Party("alice", ZKATDLogDriver(pp), client)
+    bob_p = Party("bob", ZKATDLogDriver(pp), client)
+    iw = issuer_p.new_issuer_wallet("issuer")
+    pp.add_issuer(iw.identity)
+    alice = alice_p.new_owner_wallet("alice", anonymous=False)
+    bob = bob_p.new_owner_wallet("bob", anonymous=False)
+    try:
+        seed = Transaction(issuer_p, "zk-seed")
+        seed.issue("issuer", "USD", [5] * 8,
+                   [alice.recipient_identity()] * 8, anonymous=False)
+        seed.collect_endorsements(None)
+        seed.submit()
+
+        reqs = []
+        for i in range(8):
+            t = Transaction(alice_p, f"zk-pay-{i}")
+            t.transfer("alice", "USD", [5], [bob.recipient_identity()])  # (1,1)
+            t.collect_endorsements(None)
+            reqs.append(t.request.to_bytes())
+
+        batched_before = mx.REGISTRY.counter("ledger.validate.batched").value
+        bt_before = mx.REGISTRY.counter("batch.transfer.txs").value
+        h0 = net.height()
+        events = client.submit_many(reqs)
+    finally:
+        server.stop()
+
+    assert [e.status for e in events] == [TxStatus.VALID] * 8
+    # one block, all 8 proofs through the batched device plane
+    assert net.height() == h0 + 1
+    assert mx.REGISTRY.counter("ledger.validate.batched").value - batched_before == 8
+    assert mx.REGISTRY.counter("batch.transfer.txs").value - bt_before == 8
+    # one DISTINCT trace per tx, reported on the finality event
+    trace_ids = [e.trace_id for e in events]
+    assert all(trace_ids) and len(set(trace_ids)) == 8
+
+    # per-tx stitched trace: client leg + server orderer leg + validate
+    for event in events:
+        names = {s["name"] for s in _trace_spans(event.trace_id)}
+        assert "remote.submit" in names, (event.tx_id, names)
+        assert "orderer.queue" in names, (event.tx_id, names)
+        assert "network.validate" in names, (event.tx_id, names)
+
+    # the block's critical path covers all 8 traces, with the device
+    # verify and WAL legs broken out
+    commits = [
+        e for e in mx.FLIGHT.tail()
+        if e["kind"] == "block.commit" and set(trace_ids) <= set(e.get("traces", ()))
+    ]
+    assert len(commits) == 1
+    commit = commits[0]
+    assert commit["txs"] == [f"zk-pay-{i}" for i in range(8)]
+    assert commit["device_verify_s"] > 0
+    assert commit["wal_s"] > 0
+    wal_evts = [
+        e for e in mx.FLIGHT.tail()
+        if e["kind"] == "wal.append" and e.get("txs") == commit["txs"]
+    ]
+    assert wal_evts, "no wal.append flight event for the block"
+    dev_evts = [
+        e for e in mx.FLIGHT.tail()
+        if e["kind"] == "verify.device" and e.get("txs") == 8
+    ]
+    assert dev_evts and dev_evts[-1]["ok"] == 8
+
+    # ftstrace assembles the timeline from dumped sidecars (client and
+    # server share this process; the stitching logic is file-agnostic)
+    metrics_path = str(tmp_path / "run.metrics.json")
+    assert mx.flush_sidecar(metrics_path) == metrics_path
+    flight_path = str(tmp_path / "run.flight.json")
+    assert os.path.exists(flight_path)
+    ftstrace = _ftstrace()
+    rc = ftstrace.timeline("zk-pay-3", [metrics_path, flight_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for needle in ("remote.submit", "orderer.queue", "network.validate",
+                   "critical path", "device_verify", "wal", "finality"):
+        assert needle in out, f"timeline missing {needle}:\n{out}"
+
+    # Chrome-trace export parses and carries span + flight events
+    chrome_path = str(tmp_path / "chrome.json")
+    assert ftstrace.export(chrome_path, [metrics_path, flight_path]) == 0
+    capsys.readouterr()
+    doc = json.loads(open(chrome_path).read())
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert {"X", "i", "M"} <= phases
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "network.validate" in names and "block.commit" in names
