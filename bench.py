@@ -66,6 +66,42 @@ def _sidecar_path() -> str:
     return os.environ.get("FTS_METRICS_SIDECAR", "BENCH.metrics.json")
 
 
+def _history_path() -> str:
+    """The perf-regression observatory file (`cmd/ftstop.py compare`
+    diffs rounds against it): next to the metrics sidecar unless
+    FTS_BENCH_HISTORY pins it elsewhere."""
+    p = os.environ.get("FTS_BENCH_HISTORY")
+    if p:
+        return p
+    d = os.path.dirname(_sidecar_path())
+    return os.path.join(d, "BENCH_history.jsonl") if d else "BENCH_history.jsonl"
+
+
+def append_history(result: dict, path: str = None) -> str:
+    """Append one result (full, enriched or degraded) to the bench
+    history JSONL — every outcome lands in the observatory, so the BENCH
+    trajectory is machine-checked instead of eyeballed. Append-only and
+    failure-tolerant: history must never cost a run its result line."""
+    row = {"ts": round(time.time(), 3), **result}
+    p = path or _history_path()
+    try:
+        with open(p, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+    except OSError as e:
+        print(f"[fts-bench] history append to {p} failed: {e}",
+              file=sys.stderr, flush=True)
+        return ""
+    return p
+
+
+def _profile_dir() -> str:
+    """Sidecar-derived jax.profiler capture dir (FTS_PROFILE=1)."""
+    p = _sidecar_path()
+    if p.endswith(".metrics.json"):
+        return p[: -len(".metrics.json")] + ".profile"
+    return p + ".profile"
+
+
 def _deadline_sidecar_path() -> str:
     """Distinct path for the pre-re-exec accounting: the CPU child reuses
     the main sidecar path and would otherwise overwrite the record of
@@ -128,36 +164,68 @@ def _platform_guard() -> str:
     return "cpu"
 
 
+def degraded_result(platform: str, deadline: float, snap: dict) -> dict:
+    """Assemble the DEGRADED result (shared bench-result schema,
+    `fabric_token_sdk_tpu/utils/benchschema.py`) from a registry
+    snapshot: whatever partial numbers the run produced plus the phase
+    it died in."""
+    gauges = snap.get("gauges", {})
+    rate = float(gauges.get("bench.throughput_tx_per_s", 0.0) or 0.0)
+    return {
+        "metric": "zkatdlog_transfer_verify_throughput",
+        "value": round(rate, 2),
+        "unit": "tx/s",
+        "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
+        "platform": platform,
+        "degraded": True,
+        "deadline_s": deadline,
+        "phase": snap.get("meta", {}).get("progress.phase", "unknown"),
+        "stage_warmup_s": round(
+            float(gauges.get("bench.stage_warmup_s", 0.0) or 0.0), 1
+        ),
+        "prove_txs_per_s": float(
+            gauges.get("bench.prove_txs_per_s", 0.0) or 0.0
+        ) or None,
+    }
+
+
+def headline_result(*, rate: float, platform: str, batch: int, runs: int,
+                    warm_s: float, provegen_s: float, provegen_host_s: float,
+                    prove_txs: int, prove_rate: float, host_rate: float,
+                    prove_degraded: bool, setup_s: float,
+                    stage_warmup_s: float) -> dict:
+    """Assemble the headline result (shared bench-result schema,
+    `fabric_token_sdk_tpu/utils/benchschema.py`; the block phase later
+    enriches a copy with `block_*` fields)."""
+    return {
+        "metric": "zkatdlog_transfer_verify_throughput",
+        "value": round(rate, 2),
+        "unit": "tx/s",
+        "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
+        "platform": platform,
+        "batch": batch,
+        "runs": runs,
+        "warmup_s": round(warm_s, 1),
+        "provegen_s": round(provegen_s, 1),
+        "provegen_host_s": round(provegen_host_s, 1),
+        "prove_txs": prove_txs,
+        "prove_txs_per_s": round(prove_rate, 3),
+        "prove_vs_host": round(prove_rate / host_rate, 3) if host_rate else None,
+        "prove_degraded": prove_degraded,
+        "setup_s": round(setup_s, 1),
+        "stage_warmup_s": round(stage_warmup_s, 1),
+    }
+
+
 def _degraded_json(platform: str, deadline: float) -> None:
     """The deadline result is never a zero-information rc=124: emit the
     result JSON in DEGRADED form (whatever partial numbers the run
     produced, plus the phase it died in) so the driver always parses
-    something."""
+    something — and record the outcome in the bench history."""
     mx = _metrics()
-    snap = mx.REGISTRY.snapshot()
-    gauges = snap.get("gauges", {})
-    rate = float(gauges.get("bench.throughput_tx_per_s", 0.0) or 0.0)
-    print(
-        json.dumps(
-            {
-                "metric": "zkatdlog_transfer_verify_throughput",
-                "value": round(rate, 2),
-                "unit": "tx/s",
-                "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
-                "platform": platform,
-                "degraded": True,
-                "deadline_s": deadline,
-                "phase": snap.get("meta", {}).get("progress.phase", "unknown"),
-                "stage_warmup_s": round(
-                    float(gauges.get("bench.stage_warmup_s", 0.0) or 0.0), 1
-                ),
-                "prove_txs_per_s": float(
-                    gauges.get("bench.prove_txs_per_s", 0.0) or 0.0
-                ) or None,
-            }
-        ),
-        flush=True,
-    )
+    result = degraded_result(platform, deadline, mx.REGISTRY.snapshot())
+    print(json.dumps(result), flush=True)
+    append_history(result)
 
 
 def _arm_deadline(platform: str) -> None:
@@ -470,13 +538,33 @@ def main() -> None:
     warm_s = time.time() - t0
     assert bool(np.all(ok)), "benchmark proofs failed to verify"
 
-    # timed runs
+    # timed runs — optionally under a programmatic jax.profiler capture
+    # (FTS_PROFILE=1): the trace of the measured region lands in a
+    # sidecar dir next to the metrics sidecar, for TensorBoard/XProf
     runs = int(os.environ.get("FTS_BENCH_RUNS", "3"))
     hb.set_phase("timed_runs", runs=runs)
+    profile_dir = None
+    if os.environ.get("FTS_PROFILE", "0") not in ("", "0"):
+        profile_dir = _profile_dir()
+        try:
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
+            mx.counter("profile.captures").inc()
+            mx.REGISTRY.set_meta("profile.dir", profile_dir)
+        except Exception as e:  # profiling must never cost the headline
+            print(f"[fts-bench] profiler capture failed to start: {e}",
+                  file=sys.stderr, flush=True)
+            profile_dir = None
     t0 = time.time()
     for _ in range(runs):
         ok = verifier.verify(txs)
     elapsed = time.time() - t0
+    if profile_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
     rate = B * runs / elapsed
 
     mx.gauge("bench.throughput_tx_per_s").set(round(rate, 2))
@@ -484,26 +572,13 @@ def main() -> None:
     mx.gauge("bench.provegen_s").set(round(gen_s, 3))
     mx.gauge("bench.setup_s").set(round(setup_s, 3))
 
-    result = {
-        "metric": "zkatdlog_transfer_verify_throughput",
-        "value": round(rate, 2),
-        "unit": "tx/s",
-        "vs_baseline": round(rate / GO_BASELINE_TX_S, 3),
-        "platform": platform,
-        "batch": B,
-        "runs": runs,
-        "warmup_s": round(warm_s, 1),
-        "provegen_s": round(gen_s, 1),
-        "provegen_host_s": round(host_prove_s, 1),
-        "prove_txs": n_dev,
-        "prove_txs_per_s": round(prove_rate, 3),
-        "prove_vs_host": round(prove_rate / host_rate, 3) if host_rate else None,
-        "prove_degraded": prove_degraded,
-        "setup_s": round(setup_s, 1),
-        "stage_warmup_s": round(
-            float(mx.REGISTRY.gauge("bench.stage_warmup_s").value or 0), 1
-        ),
-    }
+    result = headline_result(
+        rate=rate, platform=platform, batch=B, runs=runs, warm_s=warm_s,
+        provegen_s=gen_s, provegen_host_s=host_prove_s, prove_txs=n_dev,
+        prove_rate=prove_rate, host_rate=host_rate,
+        prove_degraded=prove_degraded, setup_s=setup_s,
+        stage_warmup_s=float(mx.REGISTRY.gauge("bench.stage_warmup_s").value or 0),
+    )
     # The headline is secured the moment it exists: print it (and disarm
     # the watchdog) BEFORE the fallible block phase, so a hang or crash
     # there can never cost the completed accelerator measurement.
@@ -526,6 +601,9 @@ def main() -> None:
                 flush=True,
             )
 
+    # one observatory line per run: the final (enriched if the block
+    # phase succeeded, else headline) result joins BENCH_history.jsonl
+    append_history(result)
     hb.set_phase("done")
     hb.stop()
     mx.flush_sidecar()

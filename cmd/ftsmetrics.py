@@ -71,6 +71,9 @@ def show(path: str, prometheus: bool = False) -> None:
             lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
             lines.append(f"{m}_sum {_prom_num(h.get('sum', 0))}")
             lines.append(f"{m}_count {h.get('count', 0)}")
+            for q in ("p50", "p95", "p99"):
+                if q in h:
+                    lines.append(f"{m}_{q} {_prom_num(h[q])}")
         if lines:
             sys.stdout.write("\n".join(lines) + "\n")
         return
@@ -169,6 +172,32 @@ def show(path: str, prometheus: bool = False) -> None:
             f" recoveries={ctr.get('wal.recoveries', 0)}"
             f" faults_injected={faults_injected}"
             f" remote_retries={retries}"
+        )
+
+    # one-line live-ops summary: queue/memory state at flush time plus
+    # the latency quantiles the ops plane serves (p50/p95/p99)
+    g = d.get("gauges", {})
+    hh = d.get("histograms", {})
+    commit_h = hh.get("ledger.block.commit.seconds", {})
+    fin_h = hh.get("network.submit_to_finality.seconds", {})
+    if ("orderer.queue.depth" in g or "ledger.inflight" in g
+            or commit_h.get("count") or fin_h.get("count")):
+
+        def _qs(h):
+            if not h.get("count"):
+                return "-"
+            return "/".join(_fmt_s(h.get(q, 0.0)) for q in ("p50", "p95", "p99"))
+
+        def _mb(v):
+            return "-" if not v else f"{float(v) / 1e6:.1f}MB"
+
+        print(
+            f"ops summary: queue_depth={int(g.get('orderer.queue.depth', 0))}"
+            f" inflight={int(g.get('ledger.inflight', 0))}"
+            f" rss_peak={_mb(g.get('proc.rss.peak.bytes'))}"
+            f" dev_mem_hw={_mb(g.get('stages.mem.high_water.bytes'))}"
+            f" block_commit[p50/p95/p99]={_qs(commit_h)}"
+            f" finality[p50/p95/p99]={_qs(fin_h)}"
         )
 
     _print_kv(

@@ -1,0 +1,316 @@
+"""`top` for fts ledger nodes + the perf-regression observatory.
+
+Usage:
+    python cmd/ftstop.py top HOST:PORT [--interval S] [--count N | --once]
+    python cmd/ftstop.py compare OLD.json NEW.json [--threshold F]
+    python cmd/ftstop.py compare --history BENCH_history.jsonl [--last N]
+
+`top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
+side-effect-free and commit-lock-free server-side) and renders one line
+per poll: uptime, height, queue depth, in-flight txs, tx/s (counter
+delta between polls), batched fraction, p95 block-commit and
+submit→finality latency (bucket-interpolated quantiles computed
+node-side), and process/device memory. Ctrl-C exits cleanly.
+
+`compare` is the observatory: it diffs bench results against each other
+or against the history file `bench.py` appends every outcome to
+(`BENCH_history.jsonl`), using the shared result schema
+(`fabric_token_sdk_tpu/utils/benchschema.py`). Per-metric verdicts are
+threshold-based (default ±10%): throughput metrics regress when they
+drop, cost metrics (`stage_warmup_s`, `wal_overhead_frac`) regress when
+they grow. In history mode the baseline is the per-metric MEDIAN of the
+prior valid rounds — one outlier round cannot poison the baseline. Exit
+code 1 on any regression (CI-gateable; `--no-fail` disables).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+def _repo_on_path() -> None:
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+
+
+# ------------------------------------------------------------ top
+
+
+def parse_address(s: str) -> Tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _mb(v) -> str:
+    return "-" if v in (None, 0) else f"{float(v) / 1e6:.1f}MB"
+
+
+def _s(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1000:.0f}ms" if v < 1 else f"{v:.2f}s"
+
+
+def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
+               dt: Optional[float]) -> str:
+    """One live-view line from an `ops.health` dict + `ops.metrics`
+    snapshot (pure — unit-testable without a socket)."""
+    ctr = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    rate = None
+    if prev_snap is not None and dt and dt > 0:
+        prev_valid = prev_snap.get("counters", {}).get("network.tx.valid", 0)
+        rate = (ctr.get("network.tx.valid", 0) - prev_valid) / dt
+    batched = ctr.get("ledger.validate.batched", 0)
+    host_v = ctr.get("ledger.validate.host", 0)
+    bfrac = batched / (batched + host_v) if (batched + host_v) else None
+
+    def p95(name):
+        return hists.get(name, {}).get("p95")
+
+    parts = [
+        f"up={health.get('uptime_s', 0):.0f}s",
+        f"height={health.get('height', 0)}",
+        f"queue={health.get('queue_depth', 0)}",
+        f"inflight={health.get('inflight', 0)}",
+        "tx/s=" + ("-" if rate is None else f"{rate:.2f}"),
+        "batched=" + ("-" if bfrac is None else f"{bfrac:.0%}"),
+        f"p95.commit={_s(p95('ledger.block.commit.seconds'))}",
+        f"p95.finality={_s(p95('network.submit_to_finality.seconds'))}",
+        f"rss={_mb(gauges.get('proc.rss.bytes'))}",
+        f"dev_mem={_mb(gauges.get('device.mem.bytes'))}",
+    ]
+    wal = health.get("wal")
+    if wal:
+        parts.append(
+            f"wal={_mb(wal.get('bytes'))}"
+            + (" POISONED" if wal.get("poisoned") else "")
+        )
+    lb = health.get("last_block")
+    if lb:
+        bd = lb.get("breakdown", {})
+        parts.append(
+            f"last_block=#{lb.get('number')}[{lb.get('txs')}tx "
+            f"{_s(lb.get('commit_s'))}"
+            f" dev={_s(bd.get('device_verify_s'))}"
+            f" wal={_s(bd.get('wal_s'))}]"
+        )
+    return "  ".join(parts)
+
+
+def top(address, interval: float = None, count: Optional[int] = None,
+        out=None) -> int:
+    """Poll a node's ops plane and print one line per poll."""
+    from fabric_token_sdk_tpu.services.network.remote import RemoteNetwork
+
+    if interval is None:
+        interval = float(os.environ.get("FTS_OPS_INTERVAL_S", "2"))
+    out = out if out is not None else sys.stdout
+    addr = parse_address(address) if isinstance(address, str) else tuple(address)
+    net = RemoteNetwork(addr)
+    prev_snap, prev_t = None, None
+    i = 0
+    try:
+        while count is None or i < count:
+            if i:
+                time.sleep(interval)
+            health = net.ops_health()
+            snap = net.ops_metrics()
+            now = time.monotonic()
+            dt = (now - prev_t) if prev_t is not None else None
+            print(format_row(health, snap, prev_snap, dt), file=out, flush=True)
+            prev_snap, prev_t = snap, now
+            i += 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        net.close()
+    return 0
+
+
+# ------------------------------------------------------------ compare
+
+# (result-JSON field, direction): +1 = higher is better, -1 = lower is
+COMPARE_METRICS = (
+    ("value", +1),
+    ("block_txs_per_s", +1),
+    ("prove_txs_per_s", +1),
+    ("block_provegen_txs_per_s", +1),
+    ("stage_warmup_s", -1),
+    ("wal_overhead_frac", -1),
+)
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def compare_records(old: dict, new: dict, threshold: float = 0.1) -> List[dict]:
+    """Per-metric verdicts between two bench results: `regression` /
+    `improvement` when the direction-adjusted relative change exceeds
+    `threshold`, else `ok`. Metrics missing from either side are
+    skipped — a degraded round simply compares on fewer metrics."""
+    degraded = bool(old.get("degraded")) or bool(new.get("degraded"))
+    verdicts = []
+    for key, direction in COMPARE_METRICS:
+        if degraded and direction < 0:
+            # a deadline-truncated run's cost metrics are partial by
+            # definition (it died mid-phase) — comparing them yields
+            # spurious "improvements"; throughput drops are the signal
+            continue
+        a, b = old.get(key), new.get(key)
+        if not _num(a) or not _num(b):
+            continue
+        if a == 0 and b == 0:
+            rel = 0.0
+        elif a == 0:
+            rel = float("inf") if b > 0 else float("-inf")
+        else:
+            rel = (b - a) / abs(a)
+        score = rel * direction
+        verdict = (
+            "regression" if score < -threshold
+            else "improvement" if score > threshold
+            else "ok"
+        )
+        verdicts.append({
+            "metric": key,
+            "old": a,
+            "new": b,
+            "change_frac": rel if abs(rel) != float("inf") else None,
+            "verdict": verdict,
+        })
+    return verdicts
+
+
+def baseline_of(records: List[dict]) -> dict:
+    """Per-metric median over a set of valid rounds — the history-mode
+    baseline (one outlier round cannot poison it)."""
+    base = {}
+    for key, _dir in COMPARE_METRICS:
+        vals = [r[key] for r in records if _num(r.get(key))]
+        if vals:
+            base[key] = statistics.median(vals)
+    return base
+
+
+def compare(args) -> int:
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    if args.history:
+        rows = benchschema.load_history(args.history)
+        valid = []
+        for i, row in enumerate(rows):
+            result = benchschema.extract_result(row)
+            problems = benchschema.validate_result(result)
+            if problems:
+                print(
+                    f"[ftstop] {args.history} line {i + 1} fails the bench "
+                    f"schema ({problems[0]}) — skipped",
+                    file=sys.stderr,
+                )
+                continue
+            valid.append(result)
+        if args.last:
+            valid = valid[-args.last:]
+        if len(valid) < 2:
+            print("ftstop compare: need at least 2 schema-valid history "
+                  f"records, found {len(valid)}", file=sys.stderr)
+            return 2
+        # degraded rounds are truncated OUTCOMES, not baselines: their
+        # zero/partial metrics would drag the median toward 0 and turn a
+        # real regression into an "improvement". The LATEST round still
+        # compares whatever it is — a degraded latest is exactly the
+        # alert the observatory exists to raise.
+        prior = [r for r in valid[:-1] if not r.get("degraded")]
+        if not prior:
+            print("ftstop compare: no full (non-degraded) prior rounds to "
+                  "baseline against", file=sys.stderr)
+            return 2
+        old, new = baseline_of(prior), valid[-1]
+        old_label = f"median({len(prior)} prior full rounds)"
+        new_label = "latest round"
+    else:
+        old = benchschema.load_result(args.old)
+        new = benchschema.load_result(args.new)
+        for path, result in ((args.old, old), (args.new, new)):
+            problems = benchschema.validate_result(result)
+            if problems:
+                print(
+                    f"[ftstop] {path} fails the bench schema: "
+                    + "; ".join(problems),
+                    file=sys.stderr,
+                )
+                return 2
+        old_label, new_label = args.old, args.new
+    print(f"== {old_label} -> {new_label}  (threshold ±{args.threshold:.0%})")
+    for rec, label in ((old, old_label), (new, new_label)):
+        if rec.get("degraded"):
+            print(f"   note: {label} is a DEGRADED result "
+                  f"(died in phase {rec.get('phase', '?')!r})")
+    verdicts = compare_records(old, new, args.threshold)
+    if not verdicts:
+        print("no comparable metrics between the two records")
+        return 2
+    for v in verdicts:
+        chg = "n/a" if v["change_frac"] is None else f"{v['change_frac']:+.1%}"
+        print(
+            f"{v['verdict'].upper():<12} {v['metric']:<26} "
+            f"{v['old']:g} -> {v['new']:g}  ({chg})"
+        )
+    regressions = [v for v in verdicts if v["verdict"] == "regression"]
+    improvements = [v for v in verdicts if v["verdict"] == "improvement"]
+    print(
+        f"verdict: {len(regressions)} regression(s), "
+        f"{len(improvements)} improvement(s), "
+        f"{len(verdicts) - len(regressions) - len(improvements)} ok"
+    )
+    return 1 if regressions and not args.no_fail else 0
+
+
+# ------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ftstop", description=__doc__.splitlines()[0]
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_top = sub.add_parser("top", help="live ops view of a running node")
+    p_top.add_argument("address", help="HOST:PORT of a LedgerServer")
+    p_top.add_argument("--interval", type=float, default=None,
+                       help="poll interval seconds (FTS_OPS_INTERVAL_S)")
+    p_top.add_argument("--count", type=int, default=None,
+                       help="stop after N polls (default: forever)")
+    p_top.add_argument("--once", action="store_true",
+                       help="one poll, then exit (same as --count 1)")
+    p_cmp = sub.add_parser("compare", help="diff bench rounds for regressions")
+    p_cmp.add_argument("old", nargs="?", help="old result/round JSON")
+    p_cmp.add_argument("new", nargs="?", help="new result/round JSON")
+    p_cmp.add_argument("--history", help="BENCH_history.jsonl observatory file")
+    p_cmp.add_argument("--last", type=int, default=None,
+                       help="history mode: only consider the last N rounds")
+    p_cmp.add_argument("--threshold", type=float, default=0.1,
+                       help="relative change that counts as a verdict")
+    p_cmp.add_argument("--no-fail", action="store_true",
+                       help="exit 0 even when regressions are flagged")
+    args = ap.parse_args(argv)
+    if args.cmd == "top":
+        return top(args.address, args.interval,
+                   1 if args.once else args.count)
+    if not args.history and (not args.old or not args.new):
+        ap.error("compare needs OLD and NEW files, or --history")
+    return compare(args)
+
+
+if __name__ == "__main__":
+    _repo_on_path()
+    sys.exit(main())

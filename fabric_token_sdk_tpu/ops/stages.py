@@ -36,6 +36,7 @@ persistent cache ahead of time.
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
@@ -46,6 +47,7 @@ from jax import lax
 from . import curve as cv, curve2 as cv2, limbs as lb
 from .field import FP
 from ..utils import metrics as mx
+from ..utils import sysmon
 
 # Canonical tile height: every stage kernel sees exactly ROW_TILE flat
 # rows (batches are flattened over (B, n) and padded by repeating row 0;
@@ -175,6 +177,7 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
     # by the stage kernel — the per-kernel breakdown a critical-path
     # trace (cmd/ftstrace.py) renders under the block's device verify
     kname = getattr(kernel, "__name__", None) or type(kernel).__name__
+    t_dispatch = time.monotonic()
     with mx.span("stages.run", kernel=kname, rows=N, tiles=ntiles):
         if dp > 1 and ntiles > 1:
             spans = dp_spans(ntiles, dp)
@@ -191,6 +194,16 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
                 outs = [o for f in futs for o in f.result()]
         else:
             outs = _run_span(kernel, consts, arrays, 0, N + pad)
+    if not mx.enabled():
+        # the span above feeds stages.run.seconds only when span
+        # recording is on; the live ops plane needs the stage-dispatch
+        # latency histogram (and its quantiles) unconditionally
+        mx.histogram("stages.run.seconds").observe(
+            time.monotonic() - t_dispatch
+        )
+    # device/host memory high-water of the data plane (throttled; never
+    # compiles anything — see utils/sysmon.py)
+    sysmon.sample_stages()
     if isinstance(outs[0], (tuple, list)):
         return tuple(
             np.concatenate([np.asarray(o[i]) for o in outs])[:N]

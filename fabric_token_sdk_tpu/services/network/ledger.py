@@ -127,6 +127,9 @@ class Network:
         self._lock = threading.Lock()
         self._pipeline = BlockValidationPipeline(validator, self.policy)
         self._orderer = Orderer(self._commit_block, self.policy)
+        # last committed block's critical-path breakdown, served live by
+        # the `ops.health` RPC (assignment is atomic; readers copy)
+        self.last_block: Optional[dict] = None
         # durability plane: journal + snapshot compaction (wal.py). For an
         # EXISTING journal use `Network.recover(...)` — constructing with
         # a non-empty wal_path appends after whatever is already there.
@@ -166,6 +169,38 @@ class Network:
     def block(self, number: int) -> Optional[Block]:
         with self._lock:
             return self._blocks[number] if 0 <= number < len(self._blocks) else None
+
+    def health(self) -> dict:
+        """Side-effect-free node introspection — the body of the
+        `ops.health` RPC. Touches only the ledger lock (held briefly by
+        queries and the atomic merge) and the orderer's queue mutex,
+        NEVER the orderer's commit lock, so a minutes-long device verify
+        cannot block a health probe."""
+        with self._lock:
+            height = len(self._blocks)
+            txs_final = len(self._status)
+            last = dict(self.last_block) if self.last_block else None
+        wal = None
+        if self._wal is not None:
+            try:
+                size = os.path.getsize(self._wal.path)
+            except OSError:
+                size = -1
+            wal = {
+                "path": self._wal.path,
+                "bytes": size,
+                "sync": self._wal.sync,
+                "poisoned": self._wal.poisoned,
+            }
+        return {
+            "pid": os.getpid(),
+            "height": height,
+            "txs_final": txs_final,
+            "queue_depth": self._orderer.pending(),
+            "inflight": self._orderer.inflight(),
+            "wal": wal,
+            "last_block": last,
+        }
 
     # ------------------------------------------------------------ ordering
 
@@ -346,6 +381,18 @@ class Network:
                 host_validate_s
             )
             mx.histogram("ledger.block.merge.seconds").observe(merge_s)
+            # whole-block commit latency, always on (the quantiles the
+            # live ops plane serves), plus the breakdown `ops.health`
+            # reports for the LAST committed block
+            commit_wall_s = time.monotonic() - cut_mono
+            mx.histogram("ledger.block.commit.seconds").observe(commit_wall_s)
+            self.last_block = {
+                "number": block.number,
+                "txs": len(requests),
+                "committed_unix": round(commit_time, 3),
+                "commit_s": round(commit_wall_s, 6),
+                "breakdown": breakdown,
+            }
             if blk is not None:
                 blk.attrs.update(breakdown)
             mx.flight(

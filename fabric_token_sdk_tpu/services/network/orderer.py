@@ -87,8 +87,17 @@ class Submission:
         return self._done.is_set()
 
     def _resolve(self, event) -> None:
+        if self._done.is_set():
+            return  # idempotent: a submission resolves exactly once
         self.event = event
         self._done.set()
+        if self._orderer is not None and self.enqueued_at:
+            # live in-flight accounting + the submit→finality latency
+            # histogram (always on: the ops plane reads its quantiles)
+            self._orderer._mark_resolved()
+            mx.histogram("network.submit_to_finality.seconds").observe(
+                max(0.0, time.monotonic() - self.enqueued_at)
+            )
         mx.flight(
             "finality", trace=self.trace,
             tx=event.tx_id, status=event.status.value,
@@ -114,7 +123,11 @@ class Orderer:
         self._commit_block = commit_block
         self.policy = policy or BlockPolicy()
         self._pending: collections.deque = collections.deque()
-        self._mutex = threading.Lock()  # guards _pending
+        self._mutex = threading.Lock()  # guards _pending + _inflight
+        # submissions enqueued but not yet resolved (queued OR inside a
+        # block being committed) — the instantaneous signal `ops.health`
+        # serves; queue-wait histograms only exist after commit
+        self._inflight = 0
         # RLock: a finality listener that (re)submits must not deadlock
         self._commit_lock = threading.RLock()
 
@@ -127,6 +140,9 @@ class Orderer:
         sub.enqueued_unix = time.time()
         with self._mutex:
             self._pending.append(sub)
+            self._inflight += 1
+            mx.gauge("orderer.queue.depth").set(len(self._pending))
+            mx.gauge("ledger.inflight").set(self._inflight)
         mx.counter("ledger.ordering.enqueued").inc()
         mx.flight("submit", trace=sub.trace, tx=request.anchor)
         return sub
@@ -135,6 +151,17 @@ class Orderer:
         with self._mutex:
             return len(self._pending)
 
+    def inflight(self) -> int:
+        """Submissions enqueued but not yet resolved (includes the block
+        currently being committed, unlike `pending`)."""
+        with self._mutex:
+            return self._inflight
+
+    def _mark_resolved(self) -> None:
+        with self._mutex:
+            self._inflight -= 1
+            mx.gauge("ledger.inflight").set(self._inflight)
+
     def _cut(self) -> List[Submission]:
         # fault point BEFORE the pop: an injected cut failure strands
         # nothing — every pending submission survives for the next drive
@@ -142,6 +169,7 @@ class Orderer:
         with self._mutex:
             n = min(len(self._pending), max(1, self.policy.max_block_txs))
             batch = [self._pending.popleft() for _ in range(n)]
+            mx.gauge("orderer.queue.depth").set(len(self._pending))
         if batch:
             mx.flight("block.cut", txs=len(batch))
         return batch

@@ -28,6 +28,17 @@ returned typed (`remote.dispatch.errors.<op>`); inbound frames are
 capped (`FTS_REMOTE_MAX_FRAME`, default 16 MiB) so a corrupt or hostile
 length prefix can never force an arbitrary-size allocation.
 
+Live ops plane: the node answers side-effect-free introspection RPCs —
+`ops.health` (uptime, height, WAL state, queue depth, in-flight txs,
+last-block critical-path breakdown), `ops.metrics` (a full
+`Registry.snapshot()` over the wire, latency quantiles included) and
+`ops.flight` (live flight-ring tail). Each runs on its own handler
+thread and never takes the orderer's commit lock, so a minutes-long
+device verify cannot block a health probe; clients route them through
+`_call_idempotent` (read-only, hence retry/backoff safe). A stopping
+node answers in-flight probes with a typed `NodeStopped` error instead
+of a silently dropped connection.
+
 Fault injection: the client fires the `remote.send` / `remote.recv`
 fault points around its frame I/O (`utils/faults.py`), which is how the
 chaos suite proves the retry and exactly-once paths.
@@ -121,6 +132,8 @@ class LedgerServer:
                 raise ValueError("LedgerServer needs a validator or a network")
             network = Network(validator, policy=policy, wal_path=wal_path)
         self.network = network
+        self._started_unix = time.time()
+        self._stopping = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         outer = self
@@ -172,6 +185,9 @@ class LedgerServer:
         return self
 
     def stop(self) -> None:
+        # flag first: a probe racing the shutdown gets a typed
+        # `NodeStopped` answer instead of a silently severed connection
+        self._stopping.set()
         self._server.shutdown()
         self._server.server_close()
         # sever live client connections too: a stopped node must not keep
@@ -192,6 +208,13 @@ class LedgerServer:
 
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
+        if self._stopping.is_set():
+            # typed shutdown answer for requests already in flight when
+            # stop() began — clients can tell "node going away" from a
+            # transport fault and react without a blind retry storm
+            mx.counter("remote.dispatch.stopped").inc()
+            return {"ok": False, "error": "ledger node is stopping",
+                    "error_class": "NodeStopped"}
         # trace extraction: adopt the client's trace context so server
         # spans (dispatch, orderer, validate, WAL) stitch into ONE trace
         ctx = (
@@ -264,6 +287,29 @@ class LedgerServer:
             return {"ok": True, "status": ev.status.value, "message": ev.message}
         if op == "height":
             return {"ok": True, "height": self.network.height()}
+        # ---- live ops plane: side-effect-free introspection RPCs.
+        # These run on the connection's own handler thread and never
+        # touch the orderer's commit lock (see Network.health), so they
+        # answer DURING a long device verify, not after it.
+        if op == "ops.health":
+            try:
+                # refresh the memory gauges so the probe (and the
+                # ops.metrics snapshot a live view fetches next) reports
+                # CURRENT footprint, not the last data-plane sample
+                from ...utils import sysmon
+
+                sysmon.sample()
+            except Exception:
+                pass
+            h = self.network.health()
+            h["uptime_s"] = round(time.time() - self._started_unix, 3)
+            h["started_unix"] = round(self._started_unix, 3)
+            return {"ok": True, "health": h}
+        if op == "ops.metrics":
+            return {"ok": True, "snapshot": mx.REGISTRY.snapshot()}
+        if op == "ops.flight":
+            n = msg.get("n") or int(os.environ.get("FTS_OPS_FLIGHT_N", "64"))
+            return {"ok": True, "events": mx.FLIGHT.tail(max(1, int(n)))}
         return {"ok": False, "error": f"unknown op [{op}]",
                 "error_class": "UnknownOp"}
 
@@ -328,6 +374,10 @@ class RemoteNetwork:
             msg["trace"] = ctx.to_wire()
         with self._lock:
             self._connect_locked()
+            # timed INSIDE the lock: the pooled connection serializes
+            # callers, and waiting for another thread's in-flight call is
+            # contention, not wire latency — only send→recv is observed
+            t0 = time.monotonic()
             try:
                 faults.fire("remote.send")
                 _send_msg(self._sock, msg)
@@ -340,6 +390,11 @@ class RemoteNetwork:
             if resp is None:
                 self._close_locked()
                 raise ConnectionError("ledger server closed the connection")
+            elapsed = time.monotonic() - t0
+        # transport round-trip latency, always on (completed exchanges
+        # only — failed transports raise above): the remote leg of the
+        # live ops plane's quantile set
+        mx.histogram("remote.call.seconds").observe(elapsed)
         if not resp.get("ok"):
             if "validation_error" in resp:
                 raise ValidationError(resp["validation_error"])
@@ -509,6 +564,27 @@ class RemoteNetwork:
 
     def height(self) -> int:
         return self._call_idempotent({"op": "height"})["height"]
+
+    # ------------------------------------------------------- ops plane
+
+    def ops_health(self) -> dict:
+        """Live node introspection (`ops.health`): uptime, height, WAL
+        state, queue depth, in-flight txs, last-block critical-path
+        breakdown. Read-only, so retried like the other idempotent ops."""
+        return self._call_idempotent({"op": "ops.health"})["health"]
+
+    def ops_metrics(self) -> dict:
+        """The node's full `Registry.snapshot()` over the wire (counters,
+        gauges, histograms WITH p50/p95/p99, span summary, phases)."""
+        return self._call_idempotent({"op": "ops.metrics"})["snapshot"]
+
+    def ops_flight(self, n: Optional[int] = None) -> List[dict]:
+        """Tail of the node's live flight-recorder ring (default
+        `FTS_OPS_FLIGHT_N` events) — the crash trail, without the crash."""
+        msg: dict = {"op": "ops.flight"}
+        if n is not None:
+            msg["n"] = int(n)
+        return self._call_idempotent(msg)["events"]
 
     def apply_finality(self, request_bytes: bytes) -> Optional[FinalityEvent]:
         """Receiver-side sync: given a request distributed off-band (the

@@ -1,0 +1,155 @@
+"""One shared schema for the bench result JSON.
+
+`bench.py` emits a headline result line (optionally superseded by an
+enriched block-phase line), or a DEGRADED result when the internal
+deadline fires; every outcome is also appended to `BENCH_history.jsonl`.
+Three consumers must agree on that shape — the driver's parser, the
+perf-regression observatory (`cmd/ftstop.py compare`), and the bench
+rounds recorded as `BENCH_r*.json` — so the schema lives HERE, once,
+and `tests/test_bench_schema.py` validates both the recorded rounds and
+freshly built results against it. A round that fails this schema is a
+bug in bench.py, not in the round.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+METRIC_NAME = "zkatdlog_transfer_verify_throughput"
+UNIT = "tx/s"
+
+_NUM = (int, float)
+
+# present in EVERY result (full, enriched, degraded)
+HEADLINE_REQUIRED = {
+    "metric": str,
+    "value": _NUM,
+    "unit": str,
+    "vs_baseline": _NUM,
+    "platform": str,
+}
+
+# present only in a full (non-degraded) result
+FULL_REQUIRED = {
+    "batch": int,
+    "runs": int,
+    "warmup_s": _NUM,
+    "provegen_s": _NUM,
+    "provegen_host_s": _NUM,
+    "prove_txs": int,
+    "prove_txs_per_s": _NUM,
+    "prove_degraded": bool,
+    "setup_s": _NUM,
+    "stage_warmup_s": _NUM,
+}
+
+# present only in a degraded (deadline-fired) result
+DEGRADED_REQUIRED = {
+    "degraded": bool,
+    "deadline_s": _NUM,
+    "phase": str,
+}
+
+# type-checked when present; a tuple including NoneType allows null
+_NULLABLE_NUM = _NUM + (type(None),)
+OPTIONAL = {
+    "prove_vs_host": _NULLABLE_NUM,
+    "prove_txs_per_s": _NULLABLE_NUM,  # nullable in the degraded form
+    "stage_warmup_s": _NUM,
+    "block_txs_per_s": _NUM,
+    "block_vs_baseline": _NUM,
+    "block_txs": int,
+    "block_batched_frac": _NUM,
+    "block_provegen_s": _NUM,
+    "wal_overhead_frac": _NUM,
+    "ts": _NUM,  # history-line stamp added by bench.append_history
+}
+
+
+def is_degraded(result: dict) -> bool:
+    return bool(result.get("degraded"))
+
+
+def _check(problems: List[str], result: dict, spec: dict,
+           required: bool) -> None:
+    for key, typ in spec.items():
+        if key not in result:
+            if required:
+                problems.append(f"missing required field {key!r}")
+            continue
+        v = result[key]
+        # bool is an int subclass: reject it where a number is expected
+        if isinstance(v, bool) and typ is not bool and bool not in (
+            typ if isinstance(typ, tuple) else (typ,)
+        ):
+            problems.append(f"field {key!r} is bool, expected {typ}")
+        elif not isinstance(v, typ):
+            problems.append(
+                f"field {key!r} has type {type(v).__name__}, expected {typ}"
+            )
+
+
+def validate_result(result) -> List[str]:
+    """Return every schema problem of one bench result dict (empty list
+    = valid). Both the full and the degraded form are accepted; unknown
+    extra fields are allowed (forward compatibility)."""
+    if not isinstance(result, dict):
+        return [f"result is {type(result).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, result, HEADLINE_REQUIRED, required=True)
+    if isinstance(result.get("metric"), str) and result["metric"] != METRIC_NAME:
+        problems.append(
+            f"metric is {result['metric']!r}, expected {METRIC_NAME!r}"
+        )
+    if isinstance(result.get("unit"), str) and result["unit"] != UNIT:
+        problems.append(f"unit is {result['unit']!r}, expected {UNIT!r}")
+    if isinstance(result.get("value"), _NUM) and not isinstance(
+        result.get("value"), bool
+    ) and result["value"] < 0:
+        problems.append("value is negative")
+    if is_degraded(result):
+        _check(problems, result, DEGRADED_REQUIRED, required=True)
+    else:
+        _check(problems, result, FULL_REQUIRED, required=True)
+    _check(problems, result, OPTIONAL, required=False)
+    return problems
+
+
+def extract_result(doc) -> Optional[dict]:
+    """Pull the result dict out of any bench artifact shape: a bare
+    result, a history line, or a recorded round file (`BENCH_r*.json`,
+    whose result lives under `parsed`). None when the artifact carries
+    no parseable result (`parsed: null`)."""
+    if not isinstance(doc, dict):
+        return None
+    if "metric" in doc:
+        return doc
+    if "parsed" in doc:
+        p = doc["parsed"]
+        return p if isinstance(p, dict) else None
+    return None
+
+
+def load_result(path: str) -> Optional[dict]:
+    with open(path) as fh:
+        return extract_result(json.load(fh))
+
+
+def load_history(path: str) -> List[dict]:
+    """Read a `BENCH_history.jsonl` observatory file: one JSON object
+    per line, oldest first. Unparseable lines are skipped (a crash can
+    tear the final line; history must still load)."""
+    out: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn tail — same tolerance as the WAL
+            if isinstance(row, dict):
+                out.append(row)
+    return out

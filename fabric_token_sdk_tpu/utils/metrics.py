@@ -122,6 +122,11 @@ DEFAULT_BUCKETS = (
     10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
+# Quantile labels every histogram exports (JSON snapshot keys and
+# Prometheus `<name>_<label>` series) — the latency numbers the live ops
+# plane (`ops.metrics` RPC, `cmd/ftstop.py top`) reads.
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
 
 class Histogram:
     __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_min",
@@ -159,6 +164,36 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    @staticmethod
+    def _interp(q: float, buckets, counts, total: int,
+                lo: float, hi: float) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        `histogram_quantile` style): find the bucket where the cumulative
+        count crosses rank ``q*total`` and interpolate linearly between
+        its bounds. The result is clamped to the OBSERVED ``[min, max]``
+        — a single observation reports itself exactly, and the first
+        bucket can never report below the true minimum. A rank landing
+        in the +Inf bucket reports the observed max (the best bounded
+        estimate an unbounded bucket allows)."""
+        rank = q * total
+        cum, prev = 0, 0.0
+        for b, c in zip(buckets, counts):
+            if c and cum + c >= rank:
+                v = prev + (b - prev) * (rank - cum) / c
+                return min(max(v, lo), hi)
+            cum += c
+            prev = b
+        return hi
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile (0 < q < 1); None when empty."""
+        with self._lock:
+            if not self._count:
+                return None
+            counts = list(self._counts)
+            total, lo, hi = self._count, self._min, self._max
+        return self._interp(q, self.buckets, counts, total, lo, hi)
+
     def snapshot(self) -> dict:
         # timed acquire: may run under a signal handler (see Registry)
         acquired = self._lock.acquire(timeout=1.0)
@@ -178,6 +213,15 @@ class Histogram:
                 d["min"] = round(self._min, 6)
                 d["max"] = round(self._max, 6)
                 d["mean"] = round(self._sum / self._count, 6)
+                counts = list(self._counts)
+                for label, q in QUANTILES:
+                    d[label] = round(
+                        self._interp(
+                            q, self.buckets, counts, self._count,
+                            self._min, self._max,
+                        ),
+                        6,
+                    )
             return d
         finally:
             if acquired:
@@ -518,6 +562,7 @@ class Registry:
             with h._lock:
                 counts = list(h._counts)
                 total, s = h._count, h._sum
+                lo, hi = h._min, h._max
             for b, n in zip(h.buckets, counts):
                 cum += n
                 lines.append(f'{m}_bucket{{le="{_prom_num(b)}"}} {cum}')
@@ -525,6 +570,12 @@ class Registry:
             lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
             lines.append(f"{m}_sum {_prom_num(s)}")
             lines.append(f"{m}_count {total}")
+            if total:
+                # bucket-interpolated quantiles as companion gauges (the
+                # buckets above allow server-side histogram_quantile too)
+                for label, q in QUANTILES:
+                    v = Histogram._interp(q, h.buckets, counts, total, lo, hi)
+                    lines.append(f"{m}_{label} {_prom_num(round(v, 9))}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -615,7 +666,22 @@ class Heartbeat:
         # killed run answers "which phase was live, after what history")
         FLIGHT.record("phase", phase=name, **attrs)
         if _enabled:  # phases are gated like spans/heartbeat lines
-            REGISTRY.record_phase(prev, prev_start, now, **prev_attrs)
+            # per-phase memory telemetry: stamp the COMPLETING phase with
+            # the process/device footprint it ended at (sysmon never
+            # triggers jax backend init — safe before the platform probe)
+            done_attrs = dict(prev_attrs)
+            try:
+                from . import sysmon
+
+                mem = sysmon.sample()
+                done_attrs.setdefault("rss_mb", round(mem["rss_bytes"] / 1e6, 1))
+                if mem.get("device_bytes") is not None:
+                    done_attrs.setdefault(
+                        "dev_mem_mb", round(mem["device_bytes"] / 1e6, 1)
+                    )
+            except Exception:
+                pass  # telemetry must never break a phase change
+            REGISTRY.record_phase(prev, prev_start, now, **done_attrs)
             REGISTRY.gauge("progress.phase_start_unix").set(now)
             REGISTRY.set_meta("progress.phase", name)
         self.emit()

@@ -1,0 +1,115 @@
+"""Bench-result schema: the recorded rounds (`BENCH_r*.json`) and every
+freshly emitted result (full AND degraded) must validate against ONE
+shared helper (`utils/benchschema.py`) — the same helper `ftstop
+compare` uses — so no future bench round ever lands unparseable."""
+
+import glob
+import json
+import os
+
+import bench
+from fabric_token_sdk_tpu.utils import benchschema
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_recorded_bench_rounds_validate():
+    """Every committed round with a parsed result (main run AND the
+    default_run rider) passes the schema."""
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert rounds, "no recorded bench rounds found"
+    checked = 0
+    for path in rounds:
+        with open(path) as fh:
+            doc = json.load(fh)
+        for sub in (doc, doc.get("default_run") or {}):
+            result = benchschema.extract_result(sub)
+            if result is None:
+                continue  # parsed: null rounds predate the schema
+            problems = benchschema.validate_result(result)
+            assert not problems, f"{path}: {problems}"
+            checked += 1
+    assert checked >= 2, "BENCH_r09.json should contribute two results"
+
+
+def test_fresh_full_result_validates():
+    r = bench.headline_result(
+        rate=12.5, platform="cpu", batch=8, runs=2, warm_s=3.0,
+        provegen_s=10.0, provegen_host_s=0.4, prove_txs=4, prove_rate=0.4,
+        host_rate=5.0, prove_degraded=False, setup_s=0.1, stage_warmup_s=60.0,
+    )
+    assert benchschema.validate_result(r) == []
+    assert not benchschema.is_degraded(r)
+    # the enriched block-phase superset still validates
+    r.update({"block_txs_per_s": 0.05, "block_vs_baseline": 0.0,
+              "block_txs": 8, "block_batched_frac": 1.0,
+              "block_provegen_s": 2.0, "wal_overhead_frac": 0.001})
+    assert benchschema.validate_result(r) == []
+    # host_rate == 0 makes prove_vs_host null — still schema-valid
+    r2 = bench.headline_result(
+        rate=1.0, platform="cpu", batch=1, runs=1, warm_s=0.0,
+        provegen_s=0.0, provegen_host_s=0.0, prove_txs=1, prove_rate=0.0,
+        host_rate=0.0, prove_degraded=True, setup_s=0.0, stage_warmup_s=0.0,
+    )
+    assert r2["prove_vs_host"] is None
+    assert benchschema.validate_result(r2) == []
+
+
+def test_fresh_degraded_result_validates():
+    snap = {
+        "gauges": {"bench.throughput_tx_per_s": 0.0,
+                   "bench.stage_warmup_s": 291.7,
+                   "bench.prove_txs_per_s": 0.013},
+        "meta": {"progress.phase": "warmup_compile"},
+    }
+    r = bench.degraded_result("cpu", 2000.0, snap)
+    assert benchschema.is_degraded(r)
+    assert benchschema.validate_result(r) == []
+    assert r["phase"] == "warmup_compile"
+    assert r["prove_txs_per_s"] == 0.013
+    # empty registry (deadline fired before any gauge existed)
+    r0 = bench.degraded_result("cpu", 8.0, {})
+    assert benchschema.validate_result(r0) == []
+    assert r0["prove_txs_per_s"] is None  # nullable, still valid
+
+
+def test_schema_rejects_malformed_results():
+    assert benchschema.validate_result(None)
+    assert benchschema.validate_result([1, 2])
+    r = bench.degraded_result("cpu", 8.0, {})
+    for key, bad in (("metric", "other"), ("unit", "s"), ("value", "fast"),
+                     ("value", -1.0), ("phase", None)):
+        broken = dict(r)
+        broken[key] = bad
+        assert benchschema.validate_result(broken), (key, bad)
+    # a full result missing its required numerics is caught
+    full = {k: v for k, v in _full().items() if k != "batch"}
+    assert any("batch" in p for p in benchschema.validate_result(full))
+    # bool where a number is expected is caught (bool IS an int subclass)
+    wrong = dict(_full())
+    wrong["value"] = True
+    assert benchschema.validate_result(wrong)
+
+
+def _full():
+    return bench.headline_result(
+        rate=1.0, platform="cpu", batch=1, runs=1, warm_s=0.0,
+        provegen_s=0.0, provegen_host_s=0.0, prove_txs=1, prove_rate=1.0,
+        host_rate=1.0, prove_degraded=False, setup_s=0.0, stage_warmup_s=0.0,
+    )
+
+
+def test_history_roundtrip_with_torn_tail(tmp_path):
+    path = str(tmp_path / "BENCH_history.jsonl")
+    assert bench.append_history(_full(), path=path) == path
+    assert bench.append_history(
+        bench.degraded_result("cpu", 8.0, {}), path=path
+    ) == path
+    with open(path, "a") as fh:
+        fh.write('{"torn": ')  # crash mid-append
+    rows = benchschema.load_history(path)
+    assert len(rows) == 2  # torn tail skipped, like the WAL
+    for row in rows:
+        assert "ts" in row
+        assert benchschema.validate_result(row) == []
+    assert benchschema.is_degraded(rows[1]) and not benchschema.is_degraded(rows[0])

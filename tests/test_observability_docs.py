@@ -160,6 +160,68 @@ def test_every_emitted_metric_is_documented():
     )
 
 
+def _wire_ops():
+    """Every RPC op name `LedgerServer._dispatch_op` handles (the live
+    wire protocol, ops plane included)."""
+    path = os.path.join(
+        REPO, "fabric_token_sdk_tpu", "services", "network", "remote.py"
+    )
+    with open(path) as fh:
+        text = fh.read()
+    ops = set(re.findall(r'op == "([a-z_.]+)"', text))
+    assert ops, "no dispatch ops found in remote.py (parser drift?)"
+    return ops
+
+
+def _doc_rpc_ops(doc_text):
+    """Op names claimed by the RPC catalog table in the Live ops plane
+    section (first column of each row)."""
+    m = re.search(r"### RPC catalog(.*?)\n###? ", doc_text, re.S)
+    assert m, "docs/OBSERVABILITY.md lost its RPC catalog section"
+    return set(re.findall(r"^\|\s*`([a-z_.]+)`\s*\|", m.group(1), re.M))
+
+
+def test_rpc_catalog_matches_dispatch():
+    """The Live ops plane RPC catalog cannot rot: every wire op the
+    server dispatches is documented, and every documented op is still
+    dispatched."""
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    code_ops, doc_ops = _wire_ops(), _doc_rpc_ops(doc)
+    assert code_ops - doc_ops == set(), (
+        f"wire ops missing from the RPC catalog: {sorted(code_ops - doc_ops)}"
+    )
+    assert doc_ops - code_ops == set(), (
+        f"RPC catalog documents ops no longer dispatched: "
+        f"{sorted(doc_ops - code_ops)}"
+    )
+
+
+def test_quantile_suffixes_and_memory_gauges_documented():
+    """The quantile export (histogram `p50`/`p95`/`p99` keys and the
+    Prometheus companion series) and the memory-telemetry gauge families
+    (`stages.mem.*`, `proc.rss.*`) must be documented."""
+    from fabric_token_sdk_tpu.utils import metrics
+
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    labels = [label for label, _q in metrics.QUANTILES]
+    assert labels == ["p50", "p95", "p99"]
+    for label in labels:
+        assert f"`{label}`" in doc, f"quantile suffix {label} undocumented"
+    # the quantile keys must actually exist in a snapshot
+    h = metrics.Histogram("doccheck", buckets=(1.0,))
+    h.observe(0.5)
+    snap = h.snapshot()
+    for label in labels:
+        assert label in snap
+    for needle in ("stages.mem.high_water.bytes", "stages.mem.device.bytes",
+                   "proc.rss.bytes", "proc.rss.peak.bytes",
+                   "device.mem.bytes", "orderer.queue.depth",
+                   "ledger.inflight"):
+        assert f"`{needle}`" in doc, f"ops-plane gauge {needle} undocumented"
+
+
 def test_every_documented_metric_still_exists():
     emitted, corpus = _emitted()
     emitted_names = {name for _kind, name in emitted}
