@@ -191,6 +191,90 @@ def compare_records(old: dict, new: dict, threshold: float = 0.1) -> List[dict]:
     return verdicts
 
 
+def scaling_curve(result: dict) -> Optional[List[dict]]:
+    """The schema-valid throughput-vs-devices curve of one bench result,
+    or None (rounds predating the scaling sweep, invalid rows, or a
+    degenerate single-point curve — gating at n=1 would always pass,
+    since efficiency there is 1.0 by construction)."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    c = result.get("scaling")
+    if (
+        isinstance(c, list) and len(c) >= 2
+        and not benchschema.validate_scaling(c)
+    ):
+        return c
+    return None
+
+
+def efficiency_at(curve: List[dict], n_devices: int) -> Optional[float]:
+    for row in curve:
+        if row.get("n_devices") == n_devices:
+            return row.get("efficiency")
+    return None
+
+
+def compare_scaling(args) -> int:
+    """The scaling observatory: report the latest round's
+    throughput-vs-devices curve and gate on per-device efficiency at the
+    MAX device count — the number that says whether adding devices still
+    pays. Baseline = median efficiency at the same device count over the
+    prior rounds that measured it. Exit 1 when it regresses by more than
+    the threshold (CI-gateable; `--no-fail` disables), 2 when fewer than
+    two rounds carry a curve."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    rows = benchschema.load_history(args.history)
+    curves = []
+    for row in rows:
+        result = benchschema.extract_result(row)
+        if not result or benchschema.validate_result(result):
+            continue
+        c = scaling_curve(result)
+        if c:
+            curves.append(c)
+    if args.last:
+        curves = curves[-args.last:]
+    if len(curves) < 2:
+        print(
+            "ftstop compare --scaling: need at least 2 history rounds with "
+            f"a scaling curve, found {len(curves)}", file=sys.stderr,
+        )
+        return 2
+    latest, prior = curves[-1], curves[:-1]
+    max_n = latest[-1]["n_devices"]
+    print(f"== scaling curve, latest round (threshold ±{args.threshold:.0%})")
+    for row in latest:
+        print(
+            f"   n_devices={row['n_devices']:<3} "
+            f"block_txs_per_s={row['block_txs_per_s']:<10g} "
+            f"efficiency={row['efficiency']:.0%}"
+        )
+    base_vals = [
+        e for e in (efficiency_at(c, max_n) for c in prior) if _num(e)
+    ]
+    if not base_vals:
+        print(
+            f"ftstop compare --scaling: no prior round measured "
+            f"{max_n} devices — nothing to gate against", file=sys.stderr,
+        )
+        return 2
+    base = statistics.median(base_vals)
+    new = latest[-1]["efficiency"]
+    rel = (new - base) / abs(base) if base else 0.0
+    verdict = (
+        "regression" if rel < -args.threshold
+        else "improvement" if rel > args.threshold
+        else "ok"
+    )
+    print(
+        f"{verdict.upper():<12} efficiency@{max_n}dev "
+        f"{base:g} -> {new:g}  ({rel:+.1%}, "
+        f"median of {len(base_vals)} prior round(s))"
+    )
+    return 1 if verdict == "regression" and not args.no_fail else 0
+
+
 def baseline_of(records: List[dict]) -> dict:
     """Per-metric median over a set of valid rounds — the history-mode
     baseline (one outlier round cannot poison it)."""
@@ -300,12 +384,20 @@ def main(argv=None) -> int:
                        help="history mode: only consider the last N rounds")
     p_cmp.add_argument("--threshold", type=float, default=0.1,
                        help="relative change that counts as a verdict")
+    p_cmp.add_argument("--scaling", action="store_true",
+                       help="gate on the throughput-vs-devices curve: "
+                            "per-device efficiency at the max device count "
+                            "(history mode only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
     if args.cmd == "top":
         return top(args.address, args.interval,
                    1 if args.once else args.count)
+    if args.scaling:
+        if not args.history:
+            ap.error("compare --scaling needs --history")
+        return compare_scaling(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

@@ -34,11 +34,33 @@ from .transfer import TransferProof
 from .wellformedness import TransferWF, challenge_transfer_wf
 from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
     stages as st, tower as tw
+from ..parallel.sharding import MeshConfig
 from ..utils import metrics as mx
 
 # Canonical tile height for all stage kernels (re-exported for compat;
 # the runner lives in ops/stages.py).
 ROW_TILE = st.ROW_TILE
+
+
+class _MeshBound:
+    """Mixin: a verifier bound to an optional `MeshConfig` — its stage
+    dispatches shard over dp and its pairing products over dp x mp (the
+    per-shard stage-tile dispatch of `parallel/sharding.py`; None falls
+    back to the ambient `FTS_MESH_DEVICES`/`FTS_DP_SHARDS` env inside
+    the runners). Sharding never changes results — only dispatch."""
+
+    mesh: Optional[MeshConfig] = None
+
+    def set_mesh(self, mesh) -> None:
+        self.mesh = MeshConfig.of(mesh)
+
+    @property
+    def _dp(self) -> Optional[int]:
+        return None if self.mesh is None else self.mesh.dp
+
+    @property
+    def _mp(self) -> Optional[int]:
+        return None if self.mesh is None else self.mesh.mp
 
 
 def _spanned(name):
@@ -60,12 +82,13 @@ def _spanned(name):
 # ===================================================================
 
 
-class BatchedPSVerifier:
+class BatchedPSVerifier(_MeshBound):
     """Verifies B signatures on l-message vectors via the stage tiles."""
 
-    def __init__(self, pk, Q):
+    def __init__(self, pk, Q, mesh=None):
         self.pk_host = list(pk)
         self.Q_host = Q
+        self.set_mesh(mesh)
         self.pk_np = np.asarray(cv2.encode_points(self.pk_host))  # (l+2,3,2,L)
         self.Q_np = np.asarray(pr.encode_g2([Q]))[0]  # (2,2,L)
 
@@ -100,17 +123,19 @@ class BatchedPSVerifier:
         bases = np.broadcast_to(
             self.pk_np[1:], (B, k) + self.pk_np.shape[1:]
         ).reshape((B * k,) + self.pk_np.shape[1:])
-        terms = st.g2_mul_rows(bases, scal.reshape(B * k, lb.NLIMBS))
+        terms = st.g2_mul_rows(bases, scal.reshape(B * k, lb.NLIMBS), dp=self._dp)
         acc = st.g2_tree_sum_rows(
-            terms.reshape((B, k) + terms.shape[1:])
+            terms.reshape((B, k) + terms.shape[1:]), dp=self._dp
         )
-        acc = st.g2_add_rows(acc, np.broadcast_to(self.pk_np[0], acc.shape))
-        H_aff = st.g2_to_affine_rows(acc)  # (B, 2, 2, L)
+        acc = st.g2_add_rows(
+            acc, np.broadcast_to(self.pk_np[0], acc.shape), dp=self._dp
+        )
+        H_aff = st.g2_to_affine_rows(acc, dp=self._dp)  # (B, 2, 2, L)
         Ps = np.stack([P1, P2], axis=1)  # (B, 2, 2, L) G1 affine
         Qs = np.stack(
             [np.broadcast_to(self.Q_np, H_aff.shape), H_aff], axis=1
         )  # (B, 2, 2, 2, L)
-        gt = pr.pairing_product_staged(Ps, Qs)
+        gt = pr.pairing_product_staged(Ps, Qs, dp=self._dp, mp=self._mp)
         out = pr.gt_is_one_host(gt)
         out[malformed] = False
         return out
@@ -121,12 +146,13 @@ class BatchedPSVerifier:
 # ===================================================================
 
 
-class BatchedWFVerifier:
+class BatchedWFVerifier(_MeshBound):
     """Recomputes all Schnorr commitments of B same-shape transfer WF
     proofs via the stage tiles, then re-derives challenges on host."""
 
-    def __init__(self, pp: PublicParams):
+    def __init__(self, pp: PublicParams, mesh=None):
         self.pp = pp
+        self.set_mesh(mesh)
         self.table = cv.FixedBaseTable(pp.ped_params)
 
     @_spanned("batch.wf.verify")
@@ -192,11 +218,14 @@ class BatchedWFVerifier:
             B, n, 3, lb.NLIMBS
         )
         # com_j = prod ped_i^{resp_ji} - stmt_j^challenge over B*n flat rows
-        fixed = st.g1_msm_rows(self.table.flat, resp.reshape(B * n, 3, lb.NLIMBS))
-        sc = st.g1_mul_rows(
-            stmt_np.reshape(B * n, 3, lb.NLIMBS), np.repeat(chals, n, axis=0)
+        fixed = st.g1_msm_rows(
+            self.table.flat, resp.reshape(B * n, 3, lb.NLIMBS), dp=self._dp
         )
-        coms = st.g1_sub_rows(fixed, sc)
+        sc = st.g1_mul_rows(
+            stmt_np.reshape(B * n, 3, lb.NLIMBS), np.repeat(chals, n, axis=0),
+            dp=self._dp,
+        )
+        coms = st.g1_sub_rows(fixed, sc, dp=self._dp)
         com_pts = cv.decode_points(coms)  # B*n host points
         out = np.zeros(B, dtype=bool)
         for i, ((inputs, outputs, _), wf) in enumerate(zip(txs, proofs)):
@@ -217,7 +246,7 @@ class BatchedWFVerifier:
 # ===================================================================
 
 
-class BatchedMembershipVerifier:
+class BatchedMembershipVerifier(_MeshBound):
     """Verifies B membership proofs (the per-digit unit of range proofs).
 
     Device: GT commitment via 4-pairing products + G1 commitment via
@@ -225,8 +254,9 @@ class BatchedMembershipVerifier:
     Host: per-proof Fiat-Shamir challenge.
     """
 
-    def __init__(self, pp: PublicParams):
+    def __init__(self, pp: PublicParams, mesh=None):
         self.pp = pp
+        self.set_mesh(mesh)
         rp = pp.range_params
         self.pk = rp.sign_pk
         self.Q = rp.Q
@@ -268,25 +298,28 @@ class BatchedMembershipVerifier:
         bases = np.broadcast_to(
             self.pk_np[1:3], (B, 2) + self.pk_np.shape[1:]
         ).reshape((2 * B,) + self.pk_np.shape[1:])
-        terms = st.g2_mul_rows(bases, z[:, 0:2].reshape(2 * B, L))
+        terms = st.g2_mul_rows(bases, z[:, 0:2].reshape(2 * B, L), dp=self._dp)
         terms = terms.reshape((B, 2) + terms.shape[1:])
-        t_aff = st.g2_to_affine_rows(st.g2_add_rows(terms[:, 0], terms[:, 1]))
+        t_aff = st.g2_to_affine_rows(
+            st.g2_add_rows(terms[:, 0], terms[:, 1], dp=self._dp), dp=self._dp
+        )
 
         # G1 sides: -S^c as S^{r-c} (scalar negation — no extra neg
         # program), R^c, and P^{z_bf}; one fused to-affine pass for all
         Sj = st.affine_to_jac_np(S_np)
         Rj = st.affine_to_jac_np(R_np)
         powc = st.g1_mul_rows(
-            np.concatenate([Sj, Rj]), np.concatenate([neg_chal, z[:, 3]])
+            np.concatenate([Sj, Rj]), np.concatenate([neg_chal, z[:, 3]]),
+            dp=self._dp,
         )
-        Pz_j = st.g1_msm_rows(self.tableP.flat, z[:, 2:3])  # P^{z_bf}
-        aff = st.g1_to_affine_rows(np.concatenate([powc, Pz_j]))
+        Pz_j = st.g1_msm_rows(self.tableP.flat, z[:, 2:3], dp=self._dp)
+        aff = st.g1_to_affine_rows(np.concatenate([powc, Pz_j]), dp=self._dp)
         negSc, Rc, Pz = aff[:B], aff[B : 2 * B], aff[2 * B :]
 
         # G1 commitment: ped0^{z_v} ped1^{z_cb} - com^c
-        fixed = st.g1_msm_rows(self.table2.flat, com_resp)
-        comc = st.g1_mul_rows(com_jac, z[:, 3])
-        com_val = st.g1_sub_rows(fixed, comc)
+        fixed = st.g1_msm_rows(self.table2.flat, com_resp, dp=self._dp)
+        comc = st.g1_mul_rows(com_jac, z[:, 3], dp=self._dp)
+        com_val = st.g1_sub_rows(fixed, comc, dp=self._dp)
 
         # 4-leg pairing product via the compile-once staged tile programs
         Ps = np.stack([negSc, Rc, R_np, Pz], axis=1)  # (B, 4, 2, L)
@@ -297,7 +330,7 @@ class BatchedMembershipVerifier:
              np.broadcast_to(self.Q_np, t_aff.shape)],
             axis=1,
         )  # (B, 4, 2, 2, L)
-        gt = pr.pairing_product_staged(Ps, Qs)
+        gt = pr.pairing_product_staged(Ps, Qs, dp=self._dp, mp=self._mp)
         gt_host = tw.decode_fp12(gt)
         com_host = cv.decode_points(com_val)
         out = np.zeros(B, dtype=bool)
@@ -315,21 +348,32 @@ class BatchedMembershipVerifier:
 # ===================================================================
 
 
-class BatchedTransferVerifier:
+class BatchedTransferVerifier(_MeshBound):
     """Verifies whole blocks of same-shape zkatdlog transfer proofs.
 
     Composition mirrors `transfer.TransferVerifier` but the group/pairing
     work of ALL transactions runs through the fixed-shape stage tiles —
     the total distinct-program count is constant in `(n_in, n_out)`,
-    batch size, and parameter set.
+    batch size, and parameter set. An optional `MeshConfig` shards the
+    dispatch over dp (stage rows) x mp (pairing legs) — same
+    executables, bit-identical verdicts.
     """
 
-    def __init__(self, pp: PublicParams):
+    def __init__(self, pp: PublicParams, mesh=None):
         self.pp = pp
-        self.wf = BatchedWFVerifier(pp)
-        self.membership = BatchedMembershipVerifier(pp)
+        self.wf = BatchedWFVerifier(pp, mesh=mesh)
+        self.membership = BatchedMembershipVerifier(pp, mesh=mesh)
+        self.set_mesh(mesh)
         self.table3 = self.wf.table  # ped 3-base table
         self.table2 = self.membership.table2  # ped[:2]
+
+    def set_mesh(self, mesh) -> None:
+        super().set_mesh(mesh)
+        # tolerate set_mesh during __init__ (sub-verifiers not built yet)
+        if getattr(self, "wf", None) is not None:
+            self.wf.set_mesh(mesh)
+        if getattr(self, "membership", None) is not None:
+            self.membership.set_mesh(mesh)
 
     @_spanned("batch.transfer.verify")
     def verify(self, txs: Sequence[Tuple[list, list, bytes]]) -> np.ndarray:
@@ -428,12 +472,24 @@ class BatchedTransferVerifier:
 
         chal_rep = np.repeat(chals, n_out, axis=0)
         com_tok = st.g1_sub_rows(
-            st.g1_msm_rows(self.table3.flat, tok_resp.reshape(nl * n_out, 3, L)),
-            st.g1_mul_rows(tok_stmt.reshape(nl * n_out, 3, L), chal_rep),
+            st.g1_msm_rows(
+                self.table3.flat, tok_resp.reshape(nl * n_out, 3, L),
+                dp=self._dp,
+            ),
+            st.g1_mul_rows(
+                tok_stmt.reshape(nl * n_out, 3, L), chal_rep, dp=self._dp
+            ),
+            dp=self._dp,
         )
         com_val = st.g1_sub_rows(
-            st.g1_msm_rows(self.table2.flat, agg_resp.reshape(nl * n_out, 2, L)),
-            st.g1_mul_rows(agg_stmt.reshape(nl * n_out, 3, L), chal_rep),
+            st.g1_msm_rows(
+                self.table2.flat, agg_resp.reshape(nl * n_out, 2, L),
+                dp=self._dp,
+            ),
+            st.g1_mul_rows(
+                agg_stmt.reshape(nl * n_out, 3, L), chal_rep, dp=self._dp
+            ),
+            dp=self._dp,
         )
         com_tok_h = cv.decode_points(com_tok)
         com_val_h = cv.decode_points(com_val)

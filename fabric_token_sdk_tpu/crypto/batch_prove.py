@@ -34,6 +34,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import hostmath as hm, pssign, rangeproof, sigproof, wellformedness as wf
+from .batch import _MeshBound
 from .pedersen import BatchedPedersen
 from .setup import PublicParams
 from .transfer import TransferProof, _skip_range
@@ -42,17 +43,21 @@ from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
 from ..utils import metrics as mx
 
 
-class BatchedTransferProver:
+class BatchedTransferProver(_MeshBound):
     """Generates whole batches of same-shape zkatdlog transfer proofs.
 
     One instance caches the fixed-base window tables (Pedersen 3-base and
     2-base, PedGen) and the encoded G2 public keys — constructing it is
     the expensive part; `prove` calls are cheap and reusable across
-    shapes and batch sizes (the stage tiles are shape-invariant).
+    shapes and batch sizes (the stage tiles are shape-invariant). An
+    optional `MeshConfig` shards the commit-phase dispatch over dp
+    (stage rows) x mp (pairing legs) — same compile-once executables,
+    byte-identical proofs.
     """
 
-    def __init__(self, pp: PublicParams):
+    def __init__(self, pp: PublicParams, mesh=None):
         self.pp = pp
+        self.set_mesh(mesh)
         self.ped3 = BatchedPedersen(pp.ped_params)
         self.ped2 = BatchedPedersen(pp.ped_params[:2])
         rp = pp.range_params
@@ -103,7 +108,7 @@ class BatchedTransferProver:
         rows: List[List[int]] = []
         for d in draws:
             rows += d.commit_rows(n_in, n_out)
-        coms, _ = self.ped3.commit_ints(rows)
+        coms, _ = self.ped3.commit_ints(rows, dp=self._dp)
         out = []
         for i, (p, d) in enumerate(zip(provers, draws)):
             row = coms[i * n : (i + 1) * n]
@@ -157,7 +162,7 @@ class BatchedTransferProver:
         )
         for d in draws:
             rows2 += d.equality_value_rows()
-        coms2, _ = self.ped2.commit_ints(rows2)
+        coms2, _ = self.ped2.commit_ints(rows2, dp=self._dp)
         digit_coms = coms2[:M]
         mem_com_vals = coms2[M : 2 * M]
         eq_com_values = coms2[2 * M :]  # B*n_out
@@ -166,7 +171,7 @@ class BatchedTransferProver:
         rows3: List[List[int]] = []
         for d in draws:
             rows3 += d.equality_token_rows()
-        eq_com_tokens, _ = self.ped3.commit_ints(rows3)
+        eq_com_tokens, _ = self.ped3.commit_ints(rows3, dp=self._dp)
 
         # ---- signature randomization: (R^r, S^r) variable-base, then
         # obfuscation S'' = S^r + P^sig_bf (fixed-base + Jacobian add)
@@ -174,7 +179,8 @@ class BatchedTransferProver:
         sig_R = self.sig_R_np[digits]  # (M, 3, L) gather by digit value
         sig_S = self.sig_S_np[digits]
         rnd = st.g1_mul_rows(
-            np.concatenate([sig_R, sig_S]), np.concatenate([r_enc, r_enc])
+            np.concatenate([sig_R, sig_S]), np.concatenate([r_enc, r_enc]),
+            dp=self._dp,
         )
         rnd_R_jac, rnd_S_jac = rnd[:M], rnd[M:]
         pbf_scal = cv.encode_scalars(
@@ -182,8 +188,8 @@ class BatchedTransferProver:
         )
         # decode-free commit path: P^sig_bf feeds the Jacobian add and
         # P^rho_bf is decoded once below with the other transcript points
-        pbf_jac = self.pedP.commit_rows(pbf_scal[:, None, :])
-        obf_S_jac = st.g1_add_rows(rnd_S_jac, pbf_jac[:M])
+        pbf_jac = self.pedP.commit_rows(pbf_scal[:, None, :], dp=self._dp)
+        obf_S_jac = st.g1_add_rows(rnd_S_jac, pbf_jac[:M], dp=self._dp)
 
         # one host decode pass for everything that enters a transcript
         host_pts = cv.decode_points(
@@ -204,8 +210,10 @@ class BatchedTransferProver:
         g2_scal = cv.encode_scalars(
             [m.rho_v for m in mems] + [m.rho_h for m in mems]
         )
-        terms = st.g2_mul_rows(g2_bases, g2_scal)
-        t_aff = st.g2_to_affine_rows(st.g2_add_rows(terms[:M], terms[M:]))
+        terms = st.g2_mul_rows(g2_bases, g2_scal, dp=self._dp)
+        t_aff = st.g2_to_affine_rows(
+            st.g2_add_rows(terms[:M], terms[M:], dp=self._dp), dp=self._dp
+        )
         Ps = np.stack(
             [np.asarray(pr.encode_g1(rnd_R)), np.asarray(pr.encode_g1(p_rho))],
             axis=1,
@@ -213,7 +221,9 @@ class BatchedTransferProver:
         Qs = np.stack(
             [t_aff, np.broadcast_to(self.Q_np, t_aff.shape)], axis=1
         )  # (M, 2, 2, 2, L)
-        gts = tw.decode_fp12(pr.pairing_product_staged(Ps, Qs))
+        gts = tw.decode_fp12(
+            pr.pairing_product_staged(Ps, Qs, dp=self._dp, mp=self._mp)
+        )
 
         # ---- host Fiat-Shamir + responses (shared with the host prover)
         mem_proofs_flat: List[sigproof.MembershipProof] = []
@@ -292,11 +302,16 @@ _CACHE: List[Tuple[PublicParams, BatchedTransferProver]] = []
 _CACHE_CAP = 4
 
 
-def prover_for(pp: PublicParams) -> BatchedTransferProver:
+def prover_for(pp: PublicParams, mesh=None) -> BatchedTransferProver:
     for cached_pp, prover in _CACHE:
         if cached_pp is pp:
+            # the cache reuses TABLES; the mesh is per-caller dispatch
+            # state and re-binds on every hit (None = ambient/unsharded)
+            # so the host `TransferProver.batch` path can never inherit
+            # a mesh left over from a mesh-aware caller
+            prover.set_mesh(mesh)
             return prover
-    prover = BatchedTransferProver(pp)
+    prover = BatchedTransferProver(pp, mesh=mesh)
     _CACHE.append((pp, prover))
     if len(_CACHE) > _CACHE_CAP:
         _CACHE.pop(0)

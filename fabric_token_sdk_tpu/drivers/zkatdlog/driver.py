@@ -229,23 +229,34 @@ class ZKATDLogDriver(Driver):
         except Exception:
             return None
 
-    def batch_verifier(self):
+    def batch_verifier(self, mesh=None):
         """Cached `BatchedTransferVerifier` (imports the jax-backed ops
-        stack lazily — constructing a driver must stay light)."""
+        stack lazily — constructing a driver must stay light). The cache
+        holds the expensive tables; `mesh` is re-bound on EVERY call —
+        including `mesh=None`, which unbinds back to the ambient
+        env/unsharded dispatch — so each caller (e.g. each block
+        pipeline sharing this driver) gets exactly the dp x mp dispatch
+        it configured, never a mesh left over from a previous caller."""
         if self._batch_verifier is None:
             from ...crypto.batch import BatchedTransferVerifier
 
-            self._batch_verifier = BatchedTransferVerifier(self.pp)
+            self._batch_verifier = BatchedTransferVerifier(self.pp, mesh=mesh)
+        else:
+            self._batch_verifier.set_mesh(mesh)
         return self._batch_verifier
 
-    def batch_prover(self):
+    def batch_prover(self, mesh=None):
         """Cached `BatchedTransferProver` — the prove-side twin of
         `batch_verifier` (lazy import for the same reason; shares the
-        module-level `prover_for` cache with `TransferProver.batch`)."""
+        module-level `prover_for` cache with `TransferProver.batch`).
+        `mesh` re-binds on every call, `None` unbinds — same contract as
+        `batch_verifier`."""
         if self._batch_prover is None:
             from ...crypto.batch_prove import prover_for
 
-            self._batch_prover = prover_for(self.pp)
+            self._batch_prover = prover_for(self.pp, mesh=mesh)
+        else:
+            self._batch_prover.set_mesh(mesh)
         return self._batch_prover
 
     # ------------------------------------------------------------ tokens

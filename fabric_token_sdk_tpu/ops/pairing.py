@@ -368,19 +368,65 @@ def _product_rows(f):
     return f[:, 0]
 
 
-def pairing_product_staged(Ps, Qs, inf_mask=None):
+def _miller_tiles(Pf, Qf, start: int, stop: int):
+    """Sequential miller-tile walk over [start, stop) tile indices."""
+    return [
+        np.asarray(
+            miller_loop(
+                jnp.asarray(Pf[t : t + MILLER_TILE]),
+                jnp.asarray(Qf[t : t + MILLER_TILE]),
+            )
+        )
+        for t in range(start * MILLER_TILE, stop * MILLER_TILE, MILLER_TILE)
+    ]
+
+
+def _fexp_tiles(f, start: int, stop: int):
+    """Sequential product+final-exp walk over [start, stop) tile indices."""
+    return [
+        np.asarray(final_exp(_product_rows(jnp.asarray(f[t : t + FEXP_TILE]))))
+        for t in range(start * FEXP_TILE, stop * FEXP_TILE, FEXP_TILE)
+    ]
+
+
+def _sharded_tiles(fn, ntiles: int, workers: int, *args):
+    """The dp x mp leg of the per-shard stage-tile dispatch: delegates
+    to `stages.run_tile_spans` (the one sharded span-dispatch mechanism,
+    degrade chain included) under the pairing-plane counters."""
+    from . import stages as st
+
+    return st.run_tile_spans(
+        fn, ntiles, workers, *args,
+        calls=mx.counter("pairing.staged.sharded_calls"),
+        shards=mx.counter("pairing.staged.shards"),
+        what="pairing.staged",
+    )
+
+
+def pairing_product_staged(Ps, Qs, inf_mask=None, dp=None, mp=None):
     """prod_k e(P_k, Q_k) per row via the compile-once tile programs.
 
     Ps: (B, K, 2, L), Qs: (B, K, 2, 2, L) Montgomery affine; inf_mask
     (B, K) True legs contribute the identity. Returns (B, 6, 2, L) GT as
     a host numpy array.
+
+    `dp` x `mp` (default: the ambient mesh env, `FTS_MESH_DEVICES` /
+    `FTS_MESH_MP`) shard the dispatch: the flat (row, leg) miller-tile
+    stream splits into dp*mp contiguous spans and the final-exp tile
+    stream into dp spans, each walked through the SAME tile executables
+    from worker threads — the host-dispatch expression of "dp over rows,
+    mp over pairing legs". Zero new XLA programs; bit-identical output.
     """
+    from . import stages as st
+
     Ps = np.asarray(Ps)
     Qs = np.asarray(Qs)
     B, K = Ps.shape[0], Ps.shape[1]
     L = Ps.shape[-1]
     if B == 0:
         return np.zeros((0, 6, 2, L), dtype=np.int32)
+    dp = st.default_dp() if dp is None else max(1, int(dp))
+    mp = st.default_mp() if mp is None else max(1, int(mp))
     N = B * K
     Pf = Ps.reshape(N, 2, L)
     Qf = Qs.reshape(N, 2, 2, L)
@@ -403,15 +449,9 @@ def pairing_product_staged(Ps, Qs, inf_mask=None):
         # per-shape concatenate/select programs on the accelerator
         with mx.timed("pairing.staged.miller.seconds"):
             f = np.concatenate(
-                [
-                    np.asarray(
-                        miller_loop(
-                            jnp.asarray(Pf[t : t + MILLER_TILE]),
-                            jnp.asarray(Qf[t : t + MILLER_TILE]),
-                        )
-                    )
-                    for t in range(0, N + pad, MILLER_TILE)
-                ],
+                _sharded_tiles(
+                    _miller_tiles, (N + pad) // MILLER_TILE, dp * mp, Pf, Qf
+                ),
                 axis=0,
             )
         # numpy constant (not tw.fp12_ones()): keeps the mask/pad glue off
@@ -428,12 +468,9 @@ def pairing_product_staged(Ps, Qs, inf_mask=None):
             )
         mx.counter("pairing.staged.fexp_tiles").inc((B + padB) // FEXP_TILE)
         with mx.timed("pairing.staged.product_fexp.seconds"):
-            gts = [
-                np.asarray(
-                    final_exp(_product_rows(jnp.asarray(f[t : t + FEXP_TILE])))
-                )
-                for t in range(0, B + padB, FEXP_TILE)
-            ]
+            gts = _sharded_tiles(
+                _fexp_tiles, (B + padB) // FEXP_TILE, dp, f
+            )
     return np.concatenate(gts, axis=0)[:B]
 
 

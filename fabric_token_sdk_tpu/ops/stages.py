@@ -48,6 +48,7 @@ from . import curve as cv, curve2 as cv2, limbs as lb
 from .field import FP
 from ..utils import metrics as mx
 from ..utils import sysmon
+from ..utils.tracing import logger
 
 # Canonical tile height: every stage kernel sees exactly ROW_TILE flat
 # rows (batches are flattened over (B, n) and padded by repeating row 0;
@@ -100,15 +101,62 @@ _g2_to_affine_tile = jax.jit(cv2.to_affine_device)
 
 # ------------------------------------------------------------ tile runner
 
-def default_dp() -> int:
-    """Data-parallel shard count for the stage runner (FTS_DP_SHARDS,
-    default 1 = unsharded). Both the batched verify plane
-    (`crypto/batch.py`) and the batched prover (`crypto/batch_prove.py`)
-    flow through `run_rows`, so one knob shards both."""
+_env_clamp_seen = None
+
+
+def mesh_env() -> tuple:
+    """(n_devices, mp) from the ambient mesh env (`FTS_MESH_DEVICES`,
+    `FTS_MESH_MP`). n_devices == 0 means no mesh is configured; mp is
+    clamped to the largest divisor of n_devices so a bad pairing never
+    knocks dispatch off the sharded path. A clamp counts under
+    `sharding.clamped` — once per distinct (n, mp) misconfiguration,
+    not per dispatch (this runs on every `run_rows` call)."""
+    global _env_clamp_seen
     try:
-        return max(1, int(os.environ.get("FTS_DP_SHARDS", "1")))
+        n = int(os.environ.get("FTS_MESH_DEVICES", "0") or 0)
     except ValueError:
-        return 1
+        n = 0
+    try:
+        mp = int(os.environ.get("FTS_MESH_MP", "1") or 1)
+    except ValueError:
+        mp = 1
+    mp = max(1, mp)
+    if n > 0:
+        want = mp
+        while n % mp:
+            mp -= 1
+        if mp != want and _env_clamp_seen != (n, want):
+            _env_clamp_seen = (n, want)
+            mx.counter("sharding.clamped").inc()
+            logger.warning(
+                "sharding: ambient mesh env clamped mp %d -> %d "
+                "(FTS_MESH_DEVICES=%d)", want, mp, n,
+            )
+    return max(0, n), mp
+
+
+def default_dp() -> int:
+    """Data-parallel shard count for the stage runner: FTS_DP_SHARDS
+    when set, else the dp extent of the ambient mesh env
+    (`FTS_MESH_DEVICES` // `FTS_MESH_MP`), else 1 = unsharded. Both the
+    batched verify plane (`crypto/batch.py`) and the batched prover
+    (`crypto/batch_prove.py`) flow through `run_rows`, so one knob
+    shards both."""
+    v = os.environ.get("FTS_DP_SHARDS")
+    if v:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            return 1
+    n, mp = mesh_env()
+    return max(1, n // mp) if n > 0 else 1
+
+
+def default_mp() -> int:
+    """Model-parallel worker count of the staged pairing product (legs
+    axis), from the ambient mesh env; 1 = unsharded."""
+    n, mp = mesh_env()
+    return mp if n > 0 else 1
 
 
 def _run_span(kernel, consts, arrays, start, stop):
@@ -117,6 +165,42 @@ def _run_span(kernel, consts, arrays, start, stop):
         kernel(*consts, *(jnp.asarray(a[t : t + ROW_TILE]) for a in arrays))
         for t in range(start, stop, ROW_TILE)
     ]
+
+
+def run_tile_spans(fn, ntiles: int, workers: int, *args, calls, shards,
+                   what="stages"):
+    """The ONE sharded span-dispatch mechanism: `fn(*args, start, stop)`
+    over contiguous tile-index spans from worker threads — ridden by
+    both the row runner (`run_rows`) and the staged pairing product
+    (`ops/pairing.py`). Outputs come back in span order, so the
+    concatenated result is bit-identical to one sequential
+    `fn(*args, 0, ntiles)` walk.
+
+    Degrade chain, first link: any dispatch failure (thread-pool
+    exhaustion, a worker crash) falls back to the sequential walk
+    (`sharding.fallbacks`) — same executables, same results; the
+    verifier/pipeline host fallback remains the second link, so
+    accept/reject can never depend on sharding. `calls`/`shards` are
+    incremented on COMPLETION only: a degraded dispatch must never
+    report as sharded (tests and the observatory both read these as
+    "the sharded path actually ran")."""
+    if workers <= 1 or ntiles <= 1:
+        return fn(*args, 0, ntiles)
+    try:
+        spans = dp_spans(ntiles, workers)
+        with ThreadPoolExecutor(max_workers=len(spans)) as pool:
+            futs = [pool.submit(fn, *args, a, b) for a, b in spans]
+            outs = [o for f in futs for o in f.result()]
+        calls.inc()
+        shards.inc(len(spans))
+        return outs
+    except Exception:
+        mx.counter("sharding.fallbacks").inc()
+        logger.exception(
+            "%s: sharded dispatch failed (workers=%d); re-running "
+            "unsharded", what, workers,
+        )
+        return fn(*args, 0, ntiles)
 
 
 def dp_spans(ntiles: int, dp: int):
@@ -179,21 +263,14 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
     kname = getattr(kernel, "__name__", None) or type(kernel).__name__
     t_dispatch = time.monotonic()
     with mx.span("stages.run", kernel=kname, rows=N, tiles=ntiles):
-        if dp > 1 and ntiles > 1:
-            spans = dp_spans(ntiles, dp)
-            mx.counter("stages.sharded_calls").inc()
-            mx.counter("stages.shards").inc(len(spans))
-            with ThreadPoolExecutor(max_workers=len(spans)) as pool:
-                futs = [
-                    pool.submit(
-                        _run_span, kernel, consts, arrays,
-                        a * ROW_TILE, b * ROW_TILE,
-                    )
-                    for a, b in spans
-                ]
-                outs = [o for f in futs for o in f.result()]
-        else:
-            outs = _run_span(kernel, consts, arrays, 0, N + pad)
+        outs = run_tile_spans(
+            lambda a, b: _run_span(
+                kernel, consts, arrays, a * ROW_TILE, b * ROW_TILE
+            ),
+            ntiles, dp,
+            calls=mx.counter("stages.sharded_calls"),
+            shards=mx.counter("stages.shards"),
+        )
     if not mx.enabled():
         # the span above feeds stages.run.seconds only when span
         # recording is on; the live ops plane needs the stage-dispatch
@@ -218,42 +295,42 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
 # takes/returns HOST numpy (flat rows); `consts` device residency is the
 # caller's choice (jnp tables stay resident, numpy is transferred).
 
-def g1_msm_rows(table_flat, scalars: np.ndarray) -> np.ndarray:
+def g1_msm_rows(table_flat, scalars: np.ndarray, dp=None) -> np.ndarray:
     """(N, nbases, L) canonical scalars x fixed-base table -> (N, 3, L)."""
-    return run_rows(_g1_msm_tile, scalars, consts=(table_flat,))
+    return run_rows(_g1_msm_tile, scalars, consts=(table_flat,), dp=dp)
 
 
-def g1_mul_rows(points: np.ndarray, scalars: np.ndarray) -> np.ndarray:
+def g1_mul_rows(points: np.ndarray, scalars: np.ndarray, dp=None) -> np.ndarray:
     """Variable-base scalar mul: (N, 3, L) x (N, L) -> (N, 3, L)."""
-    return run_rows(cv.scalar_mul, points, scalars)
+    return run_rows(cv.scalar_mul, points, scalars, dp=dp)
 
 
-def g1_add_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    return run_rows(cv.add, a, b)
+def g1_add_rows(a: np.ndarray, b: np.ndarray, dp=None) -> np.ndarray:
+    return run_rows(cv.add, a, b, dp=dp)
 
 
-def g1_sub_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    return run_rows(_g1_sub_tile, a, b)
+def g1_sub_rows(a: np.ndarray, b: np.ndarray, dp=None) -> np.ndarray:
+    return run_rows(_g1_sub_tile, a, b, dp=dp)
 
 
-def g1_to_affine_rows(p: np.ndarray) -> np.ndarray:
-    return run_rows(_g1_to_affine_tile, p)
+def g1_to_affine_rows(p: np.ndarray, dp=None) -> np.ndarray:
+    return run_rows(_g1_to_affine_tile, p, dp=dp)
 
 
-def g2_mul_rows(points: np.ndarray, scalars: np.ndarray) -> np.ndarray:
+def g2_mul_rows(points: np.ndarray, scalars: np.ndarray, dp=None) -> np.ndarray:
     """(N, 3, 2, L) x (N, L) -> (N, 3, 2, L)."""
-    return run_rows(cv2.scalar_mul, points, scalars)
+    return run_rows(cv2.scalar_mul, points, scalars, dp=dp)
 
 
-def g2_add_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    return run_rows(cv2.add, a, b)
+def g2_add_rows(a: np.ndarray, b: np.ndarray, dp=None) -> np.ndarray:
+    return run_rows(cv2.add, a, b, dp=dp)
 
 
-def g2_to_affine_rows(p: np.ndarray) -> np.ndarray:
-    return run_rows(_g2_to_affine_tile, p)
+def g2_to_affine_rows(p: np.ndarray, dp=None) -> np.ndarray:
+    return run_rows(_g2_to_affine_tile, p, dp=dp)
 
 
-def g2_tree_sum_rows(terms: np.ndarray) -> np.ndarray:
+def g2_tree_sum_rows(terms: np.ndarray, dp=None) -> np.ndarray:
     """Per-row sum of k G2 terms: (N, k, 3, 2, L) -> (N, 3, 2, L).
 
     Host-side log-depth fold — each level is ONE tiled add over the
@@ -265,7 +342,7 @@ def g2_tree_sum_rows(terms: np.ndarray) -> np.ndarray:
         rest = terms[:, 2 * half :]
         flat_a = terms[:, :half].reshape((-1,) + terms.shape[2:])
         flat_b = terms[:, half : 2 * half].reshape((-1,) + terms.shape[2:])
-        summed = g2_add_rows(flat_a, flat_b).reshape(
+        summed = g2_add_rows(flat_a, flat_b, dp=dp).reshape(
             (terms.shape[0], half) + terms.shape[2:]
         )
         terms = np.concatenate([summed, rest], axis=1) if rest.shape[1] else summed

@@ -1,4 +1,5 @@
 from .sharding import (  # noqa: F401
+    MeshConfig,
     make_mesh,
     mesh_dp,
     run_rows_dp,

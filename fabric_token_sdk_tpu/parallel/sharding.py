@@ -8,20 +8,32 @@ layout (batch stays put, only 12-coefficient GT values move).
 
 Two complementary mechanisms:
 
-* **Per-shard stage-tile dispatch** (`run_rows_dp`,
-  `sharded_schnorr_rows`) — the dp axis partitions the FLAT ROW stream
-  of the staged execution model (`ops/stages.py`): each shard walks its
-  contiguous span of canonical ROW_TILE slabs through the SAME
-  compile-once tile executables, so sharding adds ZERO new XLA programs.
-  This is the dispatch used by both the batched verify plane
-  (`crypto/batch.py`) and the batched prover (`crypto/batch_prove.py`)
-  via `stages.run_rows(dp=...)` / `FTS_DP_SHARDS`. (The pre-stage-tile
-  `sharded_wf_verify_kernel`, which shard_map'ed a fused per-shape
-  reconstruction kernel — the exact program-explosion the stage tiles
-  removed — is deleted.)
-* **`shard_map` pairing product** (`sharded_pairing_product`) — the
-  dp x mp showcase for the one kernel where an in-program collective
-  pays: Miller legs shard over mp and all_gather before final exp.
+* **Per-shard stage-tile dispatch** (`MeshConfig`, `run_rows_dp`,
+  `sharded_schnorr_rows`, the default `sharded_pairing_product` path) —
+  the dp axis partitions the FLAT ROW stream of the staged execution
+  model (`ops/stages.py`) and the mp axis the pairing-leg tile stream
+  (`ops/pairing.py`): each shard walks its contiguous span of canonical
+  tile slabs through the SAME compile-once tile executables, so sharding
+  adds ZERO new XLA programs. This is the dispatch the PRODUCT planes
+  ride: `BlockValidationPipeline` group verification (`crypto/batch.py`)
+  and the batched prover (`crypto/batch_prove.py`) both accept a
+  `MeshConfig` (or the ambient `FTS_MESH_DEVICES`/`FTS_MESH_MP` /
+  `FTS_DP_SHARDS` env). Any sharded-dispatch failure degrades to the
+  unsharded runner (`sharding.fallbacks`), which itself degrades to host
+  validation — accept/reject can never depend on the mesh.
+* **`shard_map` pairing product** (`sharded_pairing_product(fused=True)`)
+  — the dp x mp showcase for the one kernel where an in-program
+  collective pays: Miller legs shard over mp and all_gather before final
+  exp. It fuses miller + product + final-exp into ONE fresh XLA program
+  per (mesh, shape), which costs a multi-minute compile on small hosts
+  (the historic `dryrun_multichip` rc=124) — so it is opt-in
+  (`FTS_SHARDED_PAIRING_FUSED=1`), for real slices where the collective
+  is worth a prepaid compile.
+
+Degrade-not-raise: `make_mesh` clamps a non-dividing `mp`
+(`sharding.clamped`) and `shard_rows` pads a non-dp-divisible batch
+(`sharding.padded_rows`) instead of erroring, so odd block-group sizes
+can never knock a node off the sharded path.
 
 The reference scales by adding Fabric endorser processes; here one mesh
 spans all chips of a slice via `jax.sharding.Mesh`.
@@ -30,7 +42,9 @@ spans all chips of a slice via `jax.sharding.Mesh`.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+import os
+from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,14 +54,77 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import curve as cv, pairing as pr, stages as st, tower as tw
 from ..ops.field import FP
+from ..utils import metrics as mx
+from ..utils.tracing import logger
+
+
+def _clamp_mp(n: int, mp: int, where: str) -> int:
+    """Largest divisor of n that is <= mp (>= 1). A non-dividing mp is
+    CLAMPED, not rejected — counted so the observatory sees it."""
+    mp = max(1, mp)
+    want = mp
+    while n % mp:
+        mp -= 1
+    if mp != want:
+        mx.counter("sharding.clamped").inc()
+        logger.warning(
+            "sharding: %s clamped mp %d -> %d (n_devices=%d)",
+            where, want, mp, n,
+        )
+    return mp
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Host-side mesh description for the per-shard stage-tile dispatch.
+
+    `n_devices` is the mesh extent (dp * mp); `dp` partitions flat rows,
+    `mp` partitions pairing legs. Unlike a `jax.sharding.Mesh` this never
+    touches the backend — the dp/mp axes exist purely in the host
+    dispatch, so a config larger than the physical device count is legal
+    (it measures dispatch-level scaling on an emulated plane)."""
+
+    n_devices: int
+    dp: int
+    mp: int = 1
+
+    @property
+    def workers(self) -> int:
+        return self.dp * self.mp
+
+    @classmethod
+    def build(cls, n_devices: int, mp: int = 1) -> "MeshConfig":
+        """Config over n_devices with mp clamped to a divisor (counted
+        under `sharding.clamped` when it had to move)."""
+        n = max(1, int(n_devices))
+        mp = _clamp_mp(n, int(mp), "MeshConfig")
+        return cls(n_devices=n, dp=n // mp, mp=mp)
+
+    @classmethod
+    def from_env(cls) -> Optional["MeshConfig"]:
+        """The ambient mesh (`FTS_MESH_DEVICES` / `FTS_MESH_MP`), or None
+        when no mesh is configured (planes then fall back to
+        `FTS_DP_SHARDS` via `stages.default_dp`)."""
+        n, mp = st.mesh_env()
+        return cls(n_devices=n, dp=n // mp, mp=mp) if n > 0 else None
+
+    @classmethod
+    def of(cls, mesh) -> Optional["MeshConfig"]:
+        """Coerce a Mesh / MeshConfig / None into a MeshConfig."""
+        if mesh is None or isinstance(mesh, cls):
+            return mesh
+        dp = int(mesh.shape["dp"])
+        mp = int(mesh.shape.get("mp", 1))
+        return cls(n_devices=dp * mp, dp=dp, mp=mp)
 
 
 def make_mesh(n_devices: Optional[int] = None, mp: int = 1) -> Mesh:
-    """Mesh of shape (dp, mp) over the first n_devices devices."""
+    """Mesh of shape (dp, mp) over the first n_devices devices. A
+    non-dividing `mp` is clamped to the largest divisor
+    (`sharding.clamped`) instead of raising."""
     devs = jax.devices()
     n = n_devices or len(devs)
-    if n % mp:
-        raise ValueError("mesh: n_devices must be divisible by mp")
+    mp = _clamp_mp(n, mp, "make_mesh")
     arr = np.array(devs[:n]).reshape(n // mp, mp)
     return Mesh(arr, ("dp", "mp"))
 
@@ -55,18 +132,26 @@ def make_mesh(n_devices: Optional[int] = None, mp: int = 1) -> Mesh:
 def shard_rows(arr, mesh: Mesh):
     """Place an array with its leading (batch) axis split over dp; any
     further sharding (e.g. mp over pairing legs) is imposed by the
-    consuming shard_map's in_specs."""
-    ndim = np.asarray(arr).ndim
-    full = P("dp", *([None] * (ndim - 1)))
-    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, full))
+    consuming shard_map's in_specs. A batch that does not divide dp is
+    PADDED to the next span boundary by repeating row 0
+    (`sharding.padded_rows`) — callers slice their result back to the
+    original row count."""
+    a = np.asarray(arr)
+    dp = int(mesh.shape["dp"])
+    pad = (-a.shape[0]) % dp
+    if pad:
+        mx.counter("sharding.padded_rows").inc(pad)
+        a = np.concatenate([a, np.broadcast_to(a[:1], (pad,) + a.shape[1:])])
+    full = P("dp", *([None] * (a.ndim - 1)))
+    return jax.device_put(jnp.asarray(a), NamedSharding(mesh, full))
 
 
-def sharded_pairing_product(Ps, Qs, mesh: Mesh):
-    """prod_k e(P_k, Q_k) per batch row, dp over rows and mp over the K
-    pairing legs; Miller values all_gather over mp, one final exp.
-
-    Ps: (B, K, 2, L), Qs: (B, K, 2, 2, L); B % dp == 0, K % mp == 0.
-    """
+def _fused_pairing_product(Ps, Qs, mesh: Mesh):
+    """prod_k e(P_k, Q_k) per batch row as ONE shard_map program: dp over
+    rows, mp over the K pairing legs; Miller values all_gather over mp,
+    one shared final exp. Ps: (B, K, 2, L), Qs: (B, K, 2, 2, L) with
+    B % dp == 0 and K % mp == 0 (the `sharded_pairing_product` wrapper
+    pads/degrades)."""
 
     @functools.partial(
         shard_map,
@@ -92,13 +177,51 @@ def sharded_pairing_product(Ps, Qs, mesh: Mesh):
     return run(Ps, Qs)
 
 
-def mesh_dp(mesh: Optional[Mesh]) -> Optional[int]:
-    """The dp extent of a mesh (None mesh -> ambient FTS_DP_SHARDS)."""
-    return None if mesh is None else int(mesh.shape["dp"])
+def sharded_pairing_product(Ps, Qs, mesh, fused: Optional[bool] = None):
+    """prod_k e(P_k, Q_k) per batch row, dp over rows and mp over the K
+    pairing legs. Returns (B, 6, 2, L) GT as host numpy.
+
+    Default path: the STAGED dispatch — `pairing_product_staged` with
+    dp x mp worker spans over the compile-once miller/product/final-exp
+    tiles (zero new XLA programs; the product planes' path). With
+    `fused=True` (or `FTS_SHARDED_PAIRING_FUSED=1`) the in-program
+    `shard_map` + `all_gather` collective runs instead — one fresh XLA
+    compile per (mesh, shape); rows are padded to a dp boundary and a
+    K not divisible by mp degrades to the staged path
+    (`sharding.fallbacks`).
+    """
+    cfg = MeshConfig.of(mesh)
+    Ps = np.asarray(Ps)
+    Qs = np.asarray(Qs)
+    if cfg is None:  # no mesh: staged dispatch with the ambient env dp/mp
+        return pr.pairing_product_staged(Ps, Qs)
+    if fused is None:
+        fused = os.environ.get("FTS_SHARDED_PAIRING_FUSED", "0") == "1"
+    if fused and isinstance(mesh, Mesh):
+        B, K = Ps.shape[0], Ps.shape[1]
+        if K % cfg.mp:
+            mx.counter("sharding.fallbacks").inc()
+            logger.warning(
+                "sharding: fused pairing product needs K %% mp == 0 "
+                "(K=%d, mp=%d); degrading to the staged dispatch", K, cfg.mp,
+            )
+        else:
+            gt = _fused_pairing_product(
+                shard_rows(Ps, mesh), shard_rows(Qs, mesh), mesh
+            )
+            return np.asarray(gt)[:B]
+    return pr.pairing_product_staged(Ps, Qs, dp=cfg.dp, mp=cfg.mp)
 
 
-def run_rows_dp(kernel, *arrays, mesh: Optional[Mesh] = None,
-                dp: Optional[int] = None, consts=()):
+def mesh_dp(mesh) -> Optional[int]:
+    """The dp extent of a Mesh or MeshConfig (None mesh -> ambient
+    FTS_DP_SHARDS / FTS_MESH_* env)."""
+    cfg = MeshConfig.of(mesh)
+    return None if cfg is None else cfg.dp
+
+
+def run_rows_dp(kernel, *arrays, mesh=None, dp: Optional[int] = None,
+                consts=()):
     """Per-shard stage-tile dispatch: partition the flat rows into dp
     contiguous ROW_TILE-aligned spans and run each span through the
     canonical compile-once tile executable (`stages.run_rows`). Results
@@ -111,7 +234,7 @@ def run_rows_dp(kernel, *arrays, mesh: Optional[Mesh] = None,
 
 
 def sharded_schnorr_rows(table: cv.FixedBaseTable, resp, stmts, chals,
-                         mesh: Optional[Mesh] = None):
+                         mesh=None):
     """Batch-parallel Schnorr commitment reconstruction over dp, as
     per-shard stage-tile dispatch: com = table^resp - stmt^chal.
 

@@ -116,7 +116,8 @@ class Network:
     def __init__(self, validator: RequestValidator,
                  policy: Optional[BlockPolicy] = None,
                  wal_path: Optional[str] = None,
-                 snapshot_every: Optional[int] = None):
+                 snapshot_every: Optional[int] = None,
+                 mesh=None):
         self.validator = validator
         self.policy = policy or BlockPolicy.from_env()
         self._state: Dict[str, bytes] = {}  # token key -> output bytes
@@ -125,7 +126,11 @@ class Network:
         self._status: Dict[str, FinalityEvent] = {}
         self._listeners: List[Callable[[FinalityEvent, TokenRequest], None]] = []
         self._lock = threading.Lock()
-        self._pipeline = BlockValidationPipeline(validator, self.policy)
+        # `mesh` (parallel.sharding.MeshConfig) shards the block-batched
+        # proof plane's dispatch over dp x mp; None = ambient env
+        # (FTS_MESH_DEVICES / FTS_DP_SHARDS), resolved in the runners
+        self._pipeline = BlockValidationPipeline(validator, self.policy,
+                                                 mesh=mesh)
         self._orderer = Orderer(self._commit_block, self.policy)
         # last committed block's critical-path breakdown, served live by
         # the `ops.health` RPC (assignment is atomic; readers copy)

@@ -239,11 +239,21 @@ class BlockValidationPipeline:
     Phase 3 is the ledger's: sequential per-tx `RequestValidator.validate`
     with MVCC over the block view; records with a verdict skip (True) or
     fail (False) the host proof check, everything else verifies on host.
+
+    `mesh` (a `parallel.sharding.MeshConfig`, default: the ambient
+    `FTS_MESH_DEVICES`/`FTS_MESH_MP` env via the verifier's own
+    resolution) shards each group's stage-tile composition over dp and
+    its pairing products over dp x mp — the per-shard stage-tile
+    dispatch. The degrade chain is sharded -> unsharded (inside the
+    runners, `sharding.fallbacks`) -> host (here, `ledger.block.
+    batch_errors`): accept/reject never depends on the mesh.
     """
 
-    def __init__(self, validator: RequestValidator, policy: BlockPolicy):
+    def __init__(self, validator: RequestValidator, policy: BlockPolicy,
+                 mesh=None):
         self.validator = validator
         self.policy = policy
+        self.mesh = mesh
 
     def proof_verdicts(
         self, requests: Sequence[TokenRequest],
@@ -281,7 +291,12 @@ class BlockValidationPipeline:
                 continue
             if verifier is None:
                 try:
-                    verifier = driver.batch_verifier()
+                    try:
+                        verifier = driver.batch_verifier(mesh=self.mesh)
+                    except TypeError:
+                        # SPI compat: a custom driver predating the mesh
+                        # kwarg still serves the unsharded plane
+                        verifier = driver.batch_verifier()
                 except Exception:
                     # construction failures (device stack unavailable,
                     # OOM building tables) degrade to host validation,

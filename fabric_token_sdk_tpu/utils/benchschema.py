@@ -63,8 +63,44 @@ OPTIONAL = {
     "block_batched_frac": _NUM,
     "block_provegen_s": _NUM,
     "wal_overhead_frac": _NUM,
+    "scaling": list,  # throughput-vs-devices curve (validated per row)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
+
+# one row of the throughput-vs-devices scaling curve (`scaling` field):
+# `n_devices` is the dp x mp mesh extent the block phase ran under,
+# `block_txs_per_s` its measured rate, `efficiency` the per-device
+# speedup relative to the smallest mesh (rate_n * n_min / (n * rate_min))
+SCALING_ROW_REQUIRED = {
+    "n_devices": int,
+    "block_txs_per_s": _NUM,
+    "efficiency": _NUM,
+}
+
+
+def validate_scaling(curve) -> List[str]:
+    """Schema problems of one `scaling` curve (empty list = valid): a
+    non-empty list of rows, each carrying the required fields, with
+    strictly increasing positive device counts."""
+    if not isinstance(curve, list):
+        return [f"scaling is {type(curve).__name__}, expected list"]
+    problems: List[str] = []
+    if not curve:
+        problems.append("scaling curve is empty")
+    prev = 0
+    for i, row in enumerate(curve):
+        if not isinstance(row, dict):
+            problems.append(f"scaling[{i}] is {type(row).__name__}")
+            continue
+        _check(problems, row, SCALING_ROW_REQUIRED, required=True)
+        n = row.get("n_devices")
+        if isinstance(n, int) and not isinstance(n, bool):
+            if n <= prev:
+                problems.append(
+                    f"scaling[{i}].n_devices={n} not strictly increasing"
+                )
+            prev = n
+    return problems
 
 
 def is_degraded(result: dict) -> bool:
@@ -113,6 +149,8 @@ def validate_result(result) -> List[str]:
     else:
         _check(problems, result, FULL_REQUIRED, required=True)
     _check(problems, result, OPTIONAL, required=False)
+    if isinstance(result.get("scaling"), list):
+        problems.extend(validate_scaling(result["scaling"]))
     return problems
 
 

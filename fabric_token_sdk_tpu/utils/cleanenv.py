@@ -1,0 +1,32 @@
+"""The one recipe for a local-CPU child/exec environment.
+
+The ambient environment can pin JAX onto the remote-TPU axon platform
+(a sitecustomize under ``.axon_site`` triggered by
+``PALLAS_AXON_POOL_IPS``) whose PJRT client hangs every jax call when
+the tunnel is down. Every re-exec / clean-subprocess fallback —
+``bench.py``'s CPU re-exec, ``__graft_entry__.neutralize_axon``, and
+``dryrun_multichip``'s probe delegation — must scrub the SAME three
+things; keeping the recipe here means the next variable that needs
+scrubbing is added once, not per call site. Stdlib-only on purpose:
+callers run before jax (or any heavy import) comes up.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+
+def clean_cpu_env(base: Optional[Mapping[str, str]] = None) -> dict:
+    """A copy of the environment pinned to local CPU: the axon trigger
+    removed, ``JAX_PLATFORMS=cpu``, and ``.axon_site`` stripped from
+    ``PYTHONPATH``. Callers layer their own markers (``_FTS_*_REEXEC``,
+    deadlines) on top."""
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if ".axon_site" not in p
+    )
+    return env

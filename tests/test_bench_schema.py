@@ -99,6 +99,86 @@ def _full():
     )
 
 
+def _curve(effs):
+    devs = [1, 2, 4, 8][: len(effs)]
+    return [
+        {"n_devices": d,
+         "block_txs_per_s": round(0.1 * d * effs[i], 3),
+         "efficiency": effs[i]}
+        for i, d in enumerate(devs)
+    ]
+
+
+def test_scaling_curve_schema():
+    """The throughput-vs-devices curve is schema-checked per row: a
+    result carrying a valid curve passes, malformed curves are named."""
+    r = _full()
+    r["scaling"] = _curve([1.0, 0.9, 0.8, 0.7])
+    assert benchschema.validate_result(r) == []
+    assert benchschema.validate_scaling(r["scaling"]) == []
+    # malformed shapes are caught
+    assert benchschema.validate_scaling("not-a-list")
+    assert benchschema.validate_scaling([])
+    assert benchschema.validate_scaling([{"n_devices": 1}])  # missing fields
+    dup = _curve([1.0, 0.9])
+    dup[1]["n_devices"] = 1  # not strictly increasing
+    assert benchschema.validate_scaling(dup)
+    bad = _curve([1.0, 0.9])
+    bad[0]["efficiency"] = "fast"
+    assert benchschema.validate_scaling(bad)
+    # a result with a broken curve fails result validation too
+    r["scaling"] = bad
+    assert benchschema.validate_result(r)
+
+
+def _history_with_curves(tmp_path, eff_rows):
+    path = str(tmp_path / "BENCH_history.jsonl")
+    for effs in eff_rows:
+        r = _full()
+        if effs is not None:
+            r["scaling"] = _curve(effs)
+        bench.append_history(r, path=path)
+    return path
+
+
+def test_ftstop_scaling_gate(tmp_path, capsys):
+    """`ftstop compare --scaling` reads multi-device rounds from the
+    history, reports per-device efficiency, and exits 1 only when
+    efficiency at the max device count regresses beyond the threshold."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "cmd"))
+    try:
+        import ftstop
+    finally:
+        sys.path.pop(0)
+
+    # steady efficiency -> ok (rc 0); rounds without a curve are skipped
+    path = _history_with_curves(
+        tmp_path, [[1.0, 0.9, 0.85, 0.8], None, [1.0, 0.9, 0.84, 0.79]]
+    )
+    assert ftstop.main(["compare", "--history", path, "--scaling"]) == 0
+    out = capsys.readouterr().out
+    assert "n_devices=8" in out and "efficiency=" in out and "OK" in out
+
+    # >10% efficiency drop at max devices -> regression, rc 1
+    os.makedirs(tmp_path / "r", exist_ok=True)
+    path = _history_with_curves(
+        tmp_path / "r", [[1.0, 0.9, 0.85, 0.8], [1.0, 0.88, 0.8, 0.6]]
+    )
+    assert ftstop.main(["compare", "--history", path, "--scaling"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # --no-fail downgrades the exit code, not the verdict
+    assert ftstop.main(
+        ["compare", "--history", path, "--scaling", "--no-fail"]
+    ) == 0
+
+    # fewer than two curve-carrying rounds -> rc 2
+    os.makedirs(tmp_path / "s", exist_ok=True)
+    path = _history_with_curves(tmp_path / "s", [None, [1.0, 0.9]])
+    assert ftstop.main(["compare", "--history", path, "--scaling"]) == 2
+
+
 def test_history_roundtrip_with_torn_tail(tmp_path):
     path = str(tmp_path / "BENCH_history.jsonl")
     assert bench.append_history(_full(), path=path) == path

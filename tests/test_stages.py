@@ -93,6 +93,34 @@ def test_run_rows_counts_transfers(rng):
     assert mx.REGISTRY.counter("batch.tiled.transfers").value - before == 4
 
 
+def test_run_rows_dp_edge_cases_match_host(rng):
+    """Sharded runner edges at the stage level: ntiles < dp, dp == 1
+    no-op (no sharded counters), dp == ntiles, and a consts-carrying
+    kernel (msm) — all bit-identical to the unsharded walk and correct
+    vs host math."""
+    pts = [hm.g1_mul(hm.G1_GEN, 5 + i) for i in range(9)]  # 2 ragged tiles
+    ks = _scalars(rng, 9)
+    expected = _g1_jac([hm.g1_mul(p, k) for p, k in zip(pts, ks)])
+    base = st.g1_mul_rows(_g1_jac(pts), cv.encode_scalars(ks))
+    sharded_before = mx.REGISTRY.counter("stages.sharded_calls").value
+    one = st.g1_mul_rows(_g1_jac(pts), cv.encode_scalars(ks), dp=1)
+    assert (
+        mx.REGISTRY.counter("stages.sharded_calls").value == sharded_before
+    ), "dp=1 must stay on the unsharded walk"
+    got = st.g1_mul_rows(_g1_jac(pts), cv.encode_scalars(ks), dp=8)
+    assert np.array_equal(got, base)  # dp > ntiles: one tile per shard
+    assert np.array_equal(one, base)
+    assert cv.decode_points(base) == cv.decode_points(expected)
+    # consts (window table) reach every shard of an msm dispatch
+    bases = [hm.g1_mul(hm.G1_GEN, 7 + i) for i in range(2)]
+    from fabric_token_sdk_tpu.crypto.pedersen import BatchedPedersen
+
+    ped = BatchedPedersen(bases)
+    rows = [[rng.randrange(hm.R), rng.randrange(hm.R)] for _ in range(9)]
+    host = [hm.g1_multiexp(bases, r) for r in rows]
+    assert ped.commit_ints(rows, dp=4)[0] == host
+
+
 def test_gt_is_one_host():
     one = tw.fp12_one_np()
     not_one = tw.encode_fp12([((2, 0), (0, 0), (0, 0), (0, 0), (0, 0), (0, 0))])[0]
