@@ -4,11 +4,14 @@ Usage:
     python cmd/ftstop.py top HOST:PORT [--interval S] [--count N | --once]
     python cmd/ftstop.py compare OLD.json NEW.json [--threshold F]
     python cmd/ftstop.py compare --history BENCH_history.jsonl [--last N]
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --scaling
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --soak
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
-per poll: uptime, height, queue depth, in-flight txs, tx/s (counter
-delta between polls), batched fraction, p95 block-commit and
+per poll: uptime, height, queue depth with its trend vs the previous
+poll, in-flight txs, tx/s (counter delta between polls), backpressure
+reject rate (`bp/s`), batched fraction, p95 block-commit and
 submit→finality latency (bucket-interpolated quantiles computed
 node-side), and process/device memory. Ctrl-C exits cleanly.
 
@@ -72,6 +75,24 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
     batched = ctr.get("ledger.validate.batched", 0)
     host_v = ctr.get("ledger.validate.host", 0)
     bfrac = batched / (batched + host_v) if (batched + host_v) else None
+    # queue-depth trend (delta vs the previous poll's gauge) and the
+    # backpressure reject rate — the two live signals of an admission-
+    # controlled node under sustained load
+    qd = health.get("queue_depth", 0)
+    trend = ""
+    if prev_snap is not None:
+        prev_q = prev_snap.get("gauges", {}).get("orderer.queue.depth")
+        if prev_q is not None:
+            delta = qd - prev_q
+            trend = f"({delta:+.0f})" if delta else "(=)"
+    bp_rate = None
+    if prev_snap is not None and dt and dt > 0:
+        prev_bp = prev_snap.get("counters", {}).get(
+            "orderer.backpressure.rejects", 0
+        )
+        bp_rate = (
+            ctr.get("orderer.backpressure.rejects", 0) - prev_bp
+        ) / dt
 
     def p95(name):
         return hists.get(name, {}).get("p95")
@@ -79,9 +100,10 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
     parts = [
         f"up={health.get('uptime_s', 0):.0f}s",
         f"height={health.get('height', 0)}",
-        f"queue={health.get('queue_depth', 0)}",
+        f"queue={qd}{trend}",
         f"inflight={health.get('inflight', 0)}",
         "tx/s=" + ("-" if rate is None else f"{rate:.2f}"),
+        "bp/s=" + ("-" if bp_rate is None else f"{bp_rate:.2f}"),
         "batched=" + ("-" if bfrac is None else f"{bfrac:.0%}"),
         f"p95.commit={_s(p95('ledger.block.commit.seconds'))}",
         f"p95.finality={_s(p95('network.submit_to_finality.seconds'))}",
@@ -275,6 +297,85 @@ def compare_scaling(args) -> int:
     return 1 if verdict == "regression" and not args.no_fail else 0
 
 
+def soak_of(result: dict) -> Optional[dict]:
+    """The `soak` section of one schema-valid bench result, or None.
+    (Callers filter through `validate_result` first, which already
+    field-checks any dict-typed soak section — no re-validation here.)"""
+    s = result.get("soak")
+    return s if isinstance(s, dict) else None
+
+
+# (soak field, direction): +1 = higher is better, -1 = lower is better
+SOAK_METRICS = (
+    ("steady_txs_per_s", +1),
+    ("p99_finality_s", -1),
+)
+
+
+def compare_soak(args) -> int:
+    """The soak observatory: gate on the sustained-load numbers —
+    steady-state tx/s regresses when it drops, p99 finality when it
+    grows — against the per-metric MEDIAN of the prior soak-carrying
+    history rounds (same pattern as `--scaling`). Exit 1 on regression
+    (CI-gateable; `--no-fail` disables), 2 when fewer than two rounds
+    carry a soak section."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    rows = benchschema.load_history(args.history)
+    soaks = []
+    for row in rows:
+        result = benchschema.extract_result(row)
+        if not result or benchschema.validate_result(result):
+            continue
+        s = soak_of(result)
+        if s:
+            soaks.append(s)
+    if args.last:
+        soaks = soaks[-args.last:]
+    if len(soaks) < 2:
+        print(
+            "ftstop compare --soak: need at least 2 history rounds with a "
+            f"soak section, found {len(soaks)}", file=sys.stderr,
+        )
+        return 2
+    latest, prior = soaks[-1], soaks[:-1]
+    print(
+        f"== soak, latest round (threshold ±{args.threshold:.0%}): "
+        f"steady={latest['steady_txs_per_s']:g}tx/s "
+        f"p99_finality={latest.get('p99_finality_s')} "
+        f"queue_max={latest['queue_depth_max']:g} "
+        f"backpressure={latest['backpressure_rejects']}"
+    )
+    regressions = 0
+    compared = 0
+    for key, direction in SOAK_METRICS:
+        base_vals = [s[key] for s in prior if _num(s.get(key))]
+        new = latest.get(key)
+        if not base_vals or not _num(new):
+            continue
+        base = statistics.median(base_vals)
+        rel = (new - base) / abs(base) if base else 0.0
+        score = rel * direction
+        verdict = (
+            "regression" if score < -args.threshold
+            else "improvement" if score > args.threshold
+            else "ok"
+        )
+        compared += 1
+        if verdict == "regression":
+            regressions += 1
+        print(
+            f"{verdict.upper():<12} soak.{key:<20} "
+            f"{base:g} -> {new:g}  ({rel:+.1%}, "
+            f"median of {len(base_vals)} prior round(s))"
+        )
+    if not compared:
+        print("ftstop compare --soak: no comparable soak metrics",
+              file=sys.stderr)
+        return 2
+    return 1 if regressions and not args.no_fail else 0
+
+
 def baseline_of(records: List[dict]) -> dict:
     """Per-metric median over a set of valid rounds — the history-mode
     baseline (one outlier round cannot poison it)."""
@@ -388,6 +489,10 @@ def main(argv=None) -> int:
                        help="gate on the throughput-vs-devices curve: "
                             "per-device efficiency at the max device count "
                             "(history mode only)")
+    p_cmp.add_argument("--soak", action="store_true",
+                       help="gate on the sustained-load soak: steady-state "
+                            "tx/s and p99 finality vs the median of prior "
+                            "soak-carrying rounds (history mode only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
@@ -398,6 +503,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --scaling needs --history")
         return compare_scaling(args)
+    if args.soak:
+        if not args.history:
+            ap.error("compare --soak needs --history")
+        return compare_soak(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

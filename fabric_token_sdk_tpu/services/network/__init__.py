@@ -1,3 +1,4 @@
 from .ledger import Block, FinalityEvent, Network, TxStatus  # noqa: F401
-from .orderer import BlockPolicy, Orderer, Submission  # noqa: F401
+from .orderer import Backpressure, BlockPolicy, Orderer, Submission  # noqa: F401
+from .pipeline import BusyClock, PipelinedBlockEngine  # noqa: F401
 from .wal import WALError, WriteAheadLog  # noqa: F401

@@ -36,7 +36,13 @@ from ...models.token import ID
 from ...utils import faults
 from ...utils import metrics as mx
 from ...utils.tracing import logger, tracer
-from .orderer import BlockPolicy, BlockValidationPipeline, Orderer, Submission
+from .orderer import (
+    Backpressure,
+    BlockPolicy,
+    BlockValidationPipeline,
+    Orderer,
+    Submission,
+)
 from .wal import WALError, WriteAheadLog
 
 
@@ -132,6 +138,21 @@ class Network:
         self._pipeline = BlockValidationPipeline(validator, self.policy,
                                                  mesh=mesh)
         self._orderer = Orderer(self._commit_block, self.policy)
+        # pipelined block engine: overlap block N+1's batched device
+        # verify with block N's host-validate/WAL/merge (pipeline.py).
+        # FTS_BLOCK_PIPELINE=0 is the kill switch that restores the
+        # exact sequential path regardless of policy.
+        self._engine = None
+        if (
+            self.policy.pipeline
+            and os.environ.get("FTS_BLOCK_PIPELINE", "1") != "0"
+        ):
+            from .pipeline import PipelinedBlockEngine
+
+            self._engine = PipelinedBlockEngine(
+                self._verify_stage, self._commit_stage
+            )
+            self._orderer.set_engine(self._engine)
         # last committed block's critical-path breakdown, served live by
         # the `ops.health` RPC (assignment is atomic; readers copy)
         self.last_block: Optional[dict] = None
@@ -249,10 +270,28 @@ class Network:
         with mx.use_trace(ctx):
             return self._orderer.enqueue(request)
 
+    def submit_request_cooperative(self, request: TokenRequest) -> Submission:
+        """`submit_request` for BATCH submitters under a bounded ordering
+        queue: instead of surfacing `Backpressure` mid-batch (stranding
+        the already enqueued prefix), drain the queue with a flush and
+        retry — admission control sheds load from OTHER submitters while
+        a deterministic batch still lands whole. Shared by the local and
+        the remote-server `submit_many` paths."""
+        while True:
+            try:
+                return self.submit_request(request)
+            except Backpressure:
+                mx.counter("orderer.backpressure.flushes").inc()
+                self._orderer.flush()
+
     def submit_many(self, requests_bytes: List[bytes]) -> List[FinalityEvent]:
-        """Deterministic multi-tx blocks: enqueue everything, then cut +
-        commit in arrival order (`max_block_txs` txs per block)."""
-        subs = [self.submit_async(rb) for rb in requests_bytes]
+        """Deterministic multi-tx blocks: enqueue everything (cooperating
+        with admission control), then cut + commit in arrival order
+        (`max_block_txs` txs per block)."""
+        subs = [
+            self.submit_request_cooperative(TokenRequest.from_bytes(rb))
+            for rb in requests_bytes
+        ]
         self._orderer.flush()
         return [s.result() for s in subs]
 
@@ -262,13 +301,79 @@ class Network:
 
     # ------------------------------------------------------------ commit
 
-    def _commit_block(self, subs: List[Submission]) -> None:
-        """Validate + commit one cut block (called under the orderer's
-        commit lock, which serializes commits end to end). Every
-        submission in the cut is GUARANTEED a resolution — even on an
-        internal crash — or its waiters would spin forever."""
+    def _split_fresh(
+        self, subs: List[Submission], resolve_known: bool = True,
+    ) -> Tuple[List[Submission], Dict[str, List[Submission]]]:
+        """Partition a cut into fresh submissions and duplicates: an
+        anchor already recorded resolves immediately from the recorded
+        event (idempotent resubmission); an anchor appearing twice in one
+        cut validates once. `resolve_known=False` is the verify stage's
+        PROVISIONAL split — it skips work without resolving or counting,
+        because the commit stage re-checks under the final state."""
+        fresh: List[Submission] = []
+        dup_of: Dict[str, List[Submission]] = {}
+        with self._lock:
+            for sub in subs:
+                anchor = sub.request.anchor
+                known = self._status.get(anchor)
+                if known is not None:
+                    if resolve_known:
+                        mx.counter("network.submit.resubmissions").inc()
+                        sub._resolve(known)
+                elif anchor in dup_of:
+                    # same anchor twice in one cut: validate once
+                    if resolve_known:
+                        mx.counter("network.submit.resubmissions").inc()
+                        dup_of[anchor].append(sub)
+                else:
+                    fresh.append(sub)
+                    dup_of[anchor] = []
+        return fresh, dup_of
+
+    def _verify_stage(self, subs: List[Submission]) -> dict:
+        """Stage A of the pipelined engine: the batched device verify of
+        one cut block — state-independent (proofs are checked against
+        request bytes, never ledger state), so it safely overlaps the
+        commit of the previous block. Returns verdicts keyed by
+        SUBMISSION identity: the commit stage re-runs the dedup split
+        under the final committed state (a duplicate racing across two
+        in-flight blocks must resolve from the recorded verdict), and
+        identity keys survive that re-split where indices would not."""
+        cut_mono, cut_unix = time.monotonic(), time.time()
+        timings: dict = {}
+        fresh, _dups = self._split_fresh(subs, resolve_known=False)
+        verdicts = self._pipeline.proof_verdicts(
+            [s.request for s in fresh], timings
+        )
+        return {
+            "verdicts": {id(fresh[ti]): v for ti, v in verdicts.items()},
+            "timings": timings,
+            "cut_mono": cut_mono,
+            "cut_unix": cut_unix,
+        }
+
+    def _commit_stage(self, subs: List[Submission], pre: Optional[dict]) -> None:
+        """Stage B of the pipelined engine (commit-worker thread)."""
+        self._commit_block(subs, pre=pre, attach_errors=True)
+
+    def _commit_block(self, subs: List[Submission],
+                      pre: Optional[dict] = None,
+                      attach_errors: bool = False) -> None:
+        """Validate + commit one cut block (serialized end to end —
+        sequential mode under the orderer's commit lock, pipelined mode
+        on the engine's single commit worker). Every submission in the
+        cut is GUARANTEED a resolution — even on an internal crash — or
+        its waiters would spin forever. `attach_errors` (pipelined mode)
+        additionally attaches an escaping exception to each stranded
+        submission so `result()` re-raises it on the waiter's stack."""
         try:
-            self._commit_block_inner(subs)
+            self._commit_block_inner(subs, pre)
+        except Exception as e:
+            if attach_errors:
+                for sub in subs:
+                    if not sub.done():
+                        sub._commit_error = e
+            raise
         finally:
             stranded = [s for s in subs if not s.done()]
             if stranded:  # internal error escaped: fail them loudly
@@ -282,29 +387,21 @@ class Network:
                         )
                     )
 
-    def _commit_block_inner(self, subs: List[Submission]) -> None:
-        fresh: List[Submission] = []
-        dup_of: Dict[str, List[Submission]] = {}
-        with self._lock:
-            for sub in subs:
-                anchor = sub.request.anchor
-                known = self._status.get(anchor)
-                if known is not None:
-                    mx.counter("network.submit.resubmissions").inc()
-                    sub._resolve(known)
-                elif anchor in dup_of:
-                    # same anchor twice in one cut: validate once
-                    mx.counter("network.submit.resubmissions").inc()
-                    dup_of[anchor].append(sub)
-                else:
-                    fresh.append(sub)
-                    dup_of[anchor] = []
+    def _commit_block_inner(self, subs: List[Submission],
+                            pre: Optional[dict] = None) -> None:
+        fresh, dup_of = self._split_fresh(subs)
         if not fresh:
             return
         requests = [s.request for s in fresh]
         # queue-wait leg of the critical path: how long each submission
-        # sat in the ordering queue before this cut picked it up
-        cut_mono, cut_unix = time.monotonic(), time.time()
+        # sat in the ordering queue before its cut picked it up (in
+        # pipelined mode the cut happened at verify-stage entry — use
+        # the stamped cut time, not the commit stage's start)
+        if pre is not None:
+            cut_mono = pre.get("cut_mono") or time.monotonic()
+            cut_unix = pre.get("cut_unix") or time.time()
+        else:
+            cut_mono, cut_unix = time.monotonic(), time.time()
         queue_wait_max = 0.0
         for sub in fresh:
             if sub.enqueued_at:
@@ -318,12 +415,27 @@ class Network:
         with mx.span("ledger.block.validate", txs=len(requests)) as blk:
             # Validation runs OUTSIDE the ledger lock: the device verify
             # (or a cold compile) and the per-tx host checks must not
-            # starve concurrent reads. This is safe because the orderer's
-            # commit lock serializes every state WRITER — readers under
-            # `self._lock` simply observe consistent pre-block state
-            # until the atomic merge below.
-            timings: dict = {}
-            verdicts = self._pipeline.proof_verdicts(requests, timings)
+            # starve concurrent reads. This is safe because every state
+            # WRITER is serialized (commit lock, or the engine's single
+            # commit worker) — readers under `self._lock` simply observe
+            # consistent pre-block state until the atomic merge below.
+            if pre is None:
+                timings: dict = {}
+                verdicts = self._pipeline.proof_verdicts(requests, timings)
+            else:
+                # stage A already verified this block (overlapping the
+                # previous block's commit): adopt its verdicts by
+                # submission identity. fresh-at-commit is a subset of
+                # fresh-at-verify, so no fresh sub can lack coverage
+                # unless stage A found no batchable group for it.
+                timings = dict(pre.get("timings") or {})
+                timings.setdefault("grouping_s", 0.0)
+                timings.setdefault("device_verify_s", 0.0)
+                pv = pre.get("verdicts") or {}
+                verdicts = {
+                    ti: pv[id(s)]
+                    for ti, s in enumerate(fresh) if id(s) in pv
+                }
             commit_time = time.time()
             view = _BlockView(self._state, self._spent)
             events: List[FinalityEvent] = []
@@ -382,6 +494,11 @@ class Network:
                 "wal_s": round(wal_s, 6),
                 "merge_s": round(merge_s, 6),
             }
+            if pre is not None:
+                # pipelined engine: how much of THIS block's device
+                # verify ran while the previous block's commit stage was
+                # still busy — the overlap the pipeline exists to create
+                breakdown["overlap_s"] = round(pre.get("overlap_s", 0.0), 6)
             mx.histogram("ledger.block.host_validate.seconds").observe(
                 host_validate_s
             )

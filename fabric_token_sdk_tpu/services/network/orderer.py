@@ -20,6 +20,19 @@ cut the next block themselves. Sequential callers therefore see one-tx
 blocks with zero added latency, while concurrent load batches naturally
 — and deterministic multi-tx blocks are available via
 `Network.submit_many` / `Orderer.flush`.
+
+Pipelined mode (`pipeline.PipelinedBlockEngine`, default on, opt-out
+`FTS_BLOCK_PIPELINE=0`): the driving thread runs only the CUT + batched
+device verify of block N+1 while a commit worker finishes block N's
+host-validate/WAL/merge — verify overlaps commit, height order is
+preserved at the hand-off queue, and waiters park on their submission's
+event (condition wait, no spinning on the commit lock).
+
+Admission control: `BlockPolicy.queue_max` (`FTS_ORDERER_QUEUE_MAX`)
+bounds the ordering queue; a full queue rejects the submission BEFORE it
+enters ordering with a typed `Backpressure` error — retry-safe by
+construction (nothing was enqueued, nothing can commit), carried over
+the wire to remote submitters.
 """
 
 from __future__ import annotations
@@ -37,6 +50,15 @@ from ...utils import faults
 from ...utils import metrics as mx
 
 
+class Backpressure(RuntimeError):
+    """The ordering queue is at `BlockPolicy.queue_max` capacity: the
+    submission was rejected BEFORE entering ordering, so a retry (with
+    backoff) is always safe — nothing was enqueued, nothing can commit,
+    and the exactly-once contract is untouched. The remote server maps
+    this to a typed wire error (`error_class: "Backpressure"`) and the
+    remote client raises it back as this same type."""
+
+
 @dataclass
 class BlockPolicy:
     """Block-cut + batched-validation policy.
@@ -47,12 +69,21 @@ class BlockPolicy:
     `min_batch`      — smallest same-shape transfer group worth a device
                        batch call; smaller groups take the host path.
     `use_batched`    — master switch for the batched proof plane.
+    `queue_max`      — admission control: ordering-queue depth beyond
+                       which enqueues are rejected with `Backpressure`
+                       (0 = unbounded, the default).
+    `pipeline`       — verify/commit overlap via the pipelined block
+                       engine (`FTS_BLOCK_PIPELINE=0` force-disables it
+                       regardless of this field — the env kill switch
+                       always restores the exact sequential path).
     """
 
     max_block_txs: int = 64
     linger_s: float = 0.0
     min_batch: int = 2
     use_batched: bool = True
+    queue_max: int = 0
+    pipeline: bool = True
 
     @classmethod
     def from_env(cls) -> "BlockPolicy":
@@ -61,6 +92,8 @@ class BlockPolicy:
             linger_s=float(os.environ.get("FTS_BLOCK_LINGER_S", "0")),
             min_batch=int(os.environ.get("FTS_BLOCK_MIN_BATCH", "2")),
             use_batched=os.environ.get("FTS_BLOCK_BATCHED", "1") != "0",
+            queue_max=int(os.environ.get("FTS_ORDERER_QUEUE_MAX", "0")),
+            pipeline=os.environ.get("FTS_BLOCK_PIPELINE", "1") != "0",
         )
 
 
@@ -72,7 +105,7 @@ class Submission:
     commit race still lands in the submitting tx's trace."""
 
     __slots__ = ("request", "event", "_done", "_orderer", "trace",
-                 "enqueued_at", "enqueued_unix")
+                 "enqueued_at", "enqueued_unix", "_commit_error")
 
     def __init__(self, orderer: Optional["Orderer"], request: TokenRequest):
         self.request = request
@@ -82,6 +115,11 @@ class Submission:
         self.trace = None  # TraceContext captured at enqueue
         self.enqueued_at = 0.0  # monotonic, for queue-wait timing
         self.enqueued_unix = 0.0
+        # pipelined mode: a commit exception from the worker thread is
+        # attached here (alongside the transient stranded event) so
+        # `result()` re-raises it on the waiter's own stack — the same
+        # contract the sequential engine gives its driving thread
+        self._commit_error = None
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -104,10 +142,14 @@ class Submission:
         )
 
     def result(self, timeout: Optional[float] = None):
-        """Block (driving commits as needed) until this tx has finality."""
-        if self._done.is_set() or self._orderer is None:
-            return self.event
-        return self._orderer.drive(self, timeout)
+        """Block (driving commits as needed) until this tx has finality.
+        Re-raises a pipelined commit-worker exception on the waiter's own
+        stack (the sequential engine raises it in the driving thread)."""
+        if not self._done.is_set() and self._orderer is not None:
+            self._orderer.drive(self, timeout)
+        if self._commit_error is not None:
+            raise self._commit_error
+        return self.event
 
 
 class Orderer:
@@ -130,6 +172,12 @@ class Orderer:
         self._inflight = 0
         # RLock: a finality listener that (re)submits must not deadlock
         self._commit_lock = threading.RLock()
+        # pipelined block engine (ledger.Network wires it when the
+        # policy + FTS_BLOCK_PIPELINE enable the verify/commit overlap)
+        self._engine = None
+
+    def set_engine(self, engine) -> None:
+        self._engine = engine
 
     # ------------------------------------------------------------ queue
 
@@ -139,6 +187,19 @@ class Orderer:
         sub.enqueued_at = time.monotonic()
         sub.enqueued_unix = time.time()
         with self._mutex:
+            qmax = self.policy.queue_max
+            if qmax > 0 and len(self._pending) >= qmax:
+                # admission control: reject BEFORE ordering, so a retry
+                # is always safe — nothing enqueued, nothing can commit
+                depth = len(self._pending)
+                mx.counter("orderer.backpressure.rejects").inc()
+                mx.flight("backpressure", trace=sub.trace,
+                          tx=request.anchor, depth=depth, max=qmax)
+                raise Backpressure(
+                    f"ordering queue at capacity ({depth}/{qmax}); "
+                    f"tx {request.anchor} rejected before ordering — "
+                    "retry with backoff"
+                )
             self._pending.append(sub)
             self._inflight += 1
             mx.gauge("orderer.queue.depth").set(len(self._pending))
@@ -176,8 +237,27 @@ class Orderer:
 
     # ------------------------------------------------------------ drive
 
+    def _pipelining(self) -> bool:
+        """True when drives should route through the pipelined engine.
+        The commit WORKER thread itself must never route back into the
+        engine (a finality listener resubmitting from inside stage B
+        would deadlock waiting on itself) — it drives inline instead."""
+        return self._engine is not None and not self._engine.on_worker_thread()
+
     def flush(self) -> None:
-        """Cut + commit blocks until the ordering queue is empty."""
+        """Cut + commit blocks until the ordering queue is empty (and, in
+        pipelined mode, every in-flight block has committed)."""
+        if self._pipelining():
+            engine = self._engine
+            while True:
+                with engine.stage_lock:
+                    batch = self._cut()
+                    if batch:
+                        engine.submit(batch)
+                if not batch:
+                    break
+            engine.drain()
+            return
         while True:
             with self._commit_lock:
                 batch = self._cut()
@@ -190,16 +270,56 @@ class Orderer:
 
         The timeout is honored even while another thread holds the commit
         lock mid-block (timed acquire), not just between commit attempts.
+        Waiters whose submission is in flight elsewhere (the pipelined
+        worker, or another driver's block) park on the submission's event
+        — a condition wait, never a spin on the commit lock.
         """
         deadline = None if timeout is None else time.monotonic() + timeout
 
+        def _remaining() -> Optional[float]:
+            return None if deadline is None else deadline - time.monotonic()
+
         def _expired() -> bool:
             return deadline is not None and time.monotonic() > deadline
+
+        def _timeout_check() -> None:
+            if not sub._done.is_set() and _expired():
+                raise TimeoutError(
+                    f"tx {sub.request.anchor} not ordered within {timeout}s"
+                )
 
         while not sub._done.is_set():
             if self.policy.linger_s > 0:
                 # a window for concurrent submitters to join this block
                 sub._done.wait(self.policy.linger_s)
+            if self._pipelining():
+                engine = self._engine
+                remaining = _remaining()
+                if remaining is None:
+                    acquired = engine.stage_lock.acquire()
+                else:
+                    acquired = remaining > 0 and engine.stage_lock.acquire(
+                        timeout=remaining
+                    )
+                batch = None
+                if acquired:
+                    try:
+                        if sub._done.is_set():
+                            break
+                        batch = self._cut()
+                        if batch:
+                            # stage A: device verify of this cut overlaps
+                            # the worker's commit of the previous block
+                            engine.submit(batch)
+                    finally:
+                        engine.stage_lock.release()
+                if not batch and not sub._done.is_set():
+                    # nothing left to cut: the sub is in flight in the
+                    # engine (or another driver's block) — park on its
+                    # event instead of re-racing the lock
+                    sub._done.wait(_remaining())
+                _timeout_check()
+                continue
             if deadline is None:
                 acquired = self._commit_lock.acquire()
             else:
@@ -216,10 +336,7 @@ class Orderer:
                         self._commit_block(batch)
                 finally:
                     self._commit_lock.release()
-            if not sub._done.is_set() and _expired():
-                raise TimeoutError(
-                    f"tx {sub.request.anchor} not ordered within {timeout}s"
-                )
+            _timeout_check()
         return sub.event
 
 

@@ -63,7 +63,7 @@ from ...utils import faults
 from ...utils import metrics as mx
 from ...utils.tracing import logger
 from .ledger import FinalityEvent, Network, TxStatus
-from .orderer import Submission
+from .orderer import Backpressure, Submission
 
 DEFAULT_MAX_FRAME = 16 * 1024 * 1024  # 16 MiB
 
@@ -227,6 +227,13 @@ class LedgerServer:
                     return self._dispatch_op(op, msg)
         except ValidationError as e:
             return {"ok": False, "validation_error": str(e)}
+        except Backpressure as e:
+            # expected load shedding, not a server fault: no traceback,
+            # typed so the client can back off and retry (the submission
+            # never entered ordering — a retry is exactly-once safe)
+            mx.counter("remote.dispatch.backpressure").inc()
+            return {"ok": False, "error": str(e),
+                    "error_class": "Backpressure"}
         except Exception as e:  # defensive: never kill the server loop —
             # but never mask the failure either: log the traceback
             # server-side and hand the client the typed exception
@@ -267,7 +274,11 @@ class LedgerServer:
             subs = []
             for request, wire in zip(parsed, traces):
                 with mx.use_trace(mx.TraceContext.from_wire(wire)):
-                    subs.append(self.network.submit_request(request))
+                    # cooperative under a bounded ordering queue — same
+                    # contract (and helper) as Network.submit_many
+                    subs.append(
+                        self.network.submit_request_cooperative(request)
+                    )
             self.network.flush()
             events = [s.result() for s in subs]
             return {"ok": True, "events": [
@@ -398,6 +409,10 @@ class RemoteNetwork:
         if not resp.get("ok"):
             if "validation_error" in resp:
                 raise ValidationError(resp["validation_error"])
+            if resp.get("error_class") == "Backpressure":
+                # the server's admission control rejected the submission
+                # BEFORE ordering: typed, retry-safe, exactly-once intact
+                raise Backpressure(resp.get("error", "ordering queue full"))
             raise RemoteError(resp.get("error", "remote error"),
                               error_class=resp.get("error_class"))
         return resp
@@ -463,6 +478,19 @@ class RemoteNetwork:
                     resp["tx_id"], TxStatus(resp["status"]), resp["message"],
                     transient=resp.get("transient", False),
                 )
+            except Backpressure as e:
+                # rejected BEFORE ordering: a plain resubmit after backoff
+                # is exactly-once safe by construction — no status probe
+                # needed (the ledger never saw the tx)
+                last = e
+                if attempt >= self.retries:
+                    raise
+                mx.counter("remote.retry.backpressure").inc()
+                mx.counter("remote.retry.attempts").inc()
+                mx.flight("retry", op="submit", attempt=attempt, tx=tx_id,
+                          backpressure=True)
+                self._backoff(attempt)
+                continue
             except (ConnectionError, OSError) as e:
                 last = e
                 if attempt >= self.retries:

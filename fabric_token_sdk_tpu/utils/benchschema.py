@@ -64,8 +64,33 @@ OPTIONAL = {
     "block_provegen_s": _NUM,
     "wal_overhead_frac": _NUM,
     "scaling": list,  # throughput-vs-devices curve (validated per row)
+    "soak": dict,  # sustained-load soak section (validated per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
+
+# the sustained-load soak section (`soak` field): steady-state tx/s of
+# the whole streaming engine under N concurrent clients, CLIENT-observed
+# p99 finality (null when the run committed nothing), the queue-depth
+# high-water (bounded by FTS_BENCH_SOAK_QUEUE_MAX admission control by
+# construction), and how many submissions backpressure rejected
+SOAK_REQUIRED = {
+    "steady_txs_per_s": _NUM,
+    "p99_finality_s": _NULLABLE_NUM,
+    "queue_depth_max": _NUM,
+    "backpressure_rejects": int,
+}
+
+
+def validate_soak(soak) -> List[str]:
+    """Schema problems of one `soak` section (empty list = valid)."""
+    if not isinstance(soak, dict):
+        return [f"soak is {type(soak).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, soak, SOAK_REQUIRED, required=True)
+    v = soak.get("steady_txs_per_s")
+    if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
+        problems.append("soak.steady_txs_per_s is negative")
+    return problems
 
 # one row of the throughput-vs-devices scaling curve (`scaling` field):
 # `n_devices` is the dp x mp mesh extent the block phase ran under,
@@ -151,6 +176,8 @@ def validate_result(result) -> List[str]:
     _check(problems, result, OPTIONAL, required=False)
     if isinstance(result.get("scaling"), list):
         problems.extend(validate_scaling(result["scaling"]))
+    if isinstance(result.get("soak"), dict):
+        problems.extend(validate_soak(result["soak"]))
     return problems
 
 

@@ -158,6 +158,59 @@ def test_block_validation_compiles_zero_programs_after_warmup(rng, pp):
     os.environ.get("FTS_WARMUP") != "1",
     reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",
 )
+def test_pipelined_blocks_compile_zero_programs_after_warmup(rng, pp):
+    """Tentpole guard: the PIPELINED block engine is pure host-side
+    scheduling — streaming TWO zk blocks through the verify/commit
+    overlap (stage A on the driving thread, stage B on the commit
+    worker) compiles zero new XLA programs and misses the compilation
+    cache zero times post-warmup."""
+    from test_orderer import build_env, issue_to, manual_transfer
+    from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+    from fabric_token_sdk_tpu.services.network import BlockPolicy
+
+    network, parties, issuer, alice, bob = build_env(
+        lambda: ZKATDLogDriver(pp),
+        BlockPolicy(max_block_txs=2, min_batch=2, pipeline=True),
+    )
+    assert network._engine is not None
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [5] * 4, "pcb-seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"pcb-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+
+    blocks_before = mx.REGISTRY.counter("orderer.pipeline.blocks").value
+    compiles_before = _compiles()
+    misses_before = mx.REGISTRY.counter(
+        "jax.compilation_cache.cache_misses"
+    ).value
+    events = network.submit_many([r.to_bytes() for r in reqs])
+    assert all(e.status.value == "Valid" for e in events)
+    # two transfer blocks really streamed through the engine...
+    assert (
+        mx.REGISTRY.counter("orderer.pipeline.blocks").value - blocks_before
+        >= 2
+    )
+    # ...with zero new program shapes and zero cache misses
+    assert _compiles() - compiles_before == 0, (
+        "the pipelined engine compiled a new XLA program — overlap must "
+        "be host-side scheduling over the canonical tile executables"
+    )
+    misses = (
+        mx.REGISTRY.counter("jax.compilation_cache.cache_misses").value
+        - misses_before
+    )
+    assert misses == 0, (
+        f"pipelined block validation missed the compilation cache "
+        f"{misses} time(s) after warmup()"
+    )
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTS_WARMUP") != "1",
+    reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",
+)
 def test_sharded_planes_compile_zero_programs_after_warmup(rng, pp):
     """Tentpole guard: the mesh-sharded dispatch (verify AND prove)
     reuses the compile-once tile executables — a dp x mp sharded block

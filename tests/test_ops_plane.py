@@ -264,7 +264,8 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
     assert wal is not None and wal["bytes"] > 0 and not wal["poisoned"]
     lb = server.network.health()["last_block"]
     assert lb is not None and lb["commit_s"] >= delay_s * 0.9
-    assert set(lb["breakdown"]) == {
+    # `overlap_s` rides along only when the pipelined engine is active
+    assert set(lb["breakdown"]) - {"overlap_s"} == {
         "queue_wait_max_s", "grouping_s", "device_verify_s",
         "host_validate_s", "wal_s", "merge_s",
     }

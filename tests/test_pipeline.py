@@ -1,0 +1,667 @@
+"""Pipelined block engine + backpressure (PR 12 tentpole).
+
+Differential identity against the sequential engine (verdicts, ledger
+state, WAL contents — including under injected device faults), strict
+height order under concurrency, verify/commit overlap accounting,
+admission control with exactly-once retry semantics (local and over the
+wire), condition-variable waits (CPU-time bounded), the prove→submit
+client pipeline, and the soak observatory plumbing (schema + `ftstop
+compare --soak`).
+"""
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto.serialization import loads
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver
+from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+from fabric_token_sdk_tpu.services.network import (
+    Backpressure,
+    BlockPolicy,
+    Network,
+    TxStatus,
+)
+from fabric_token_sdk_tpu.services.network.remote import LedgerServer, RemoteNetwork
+from fabric_token_sdk_tpu.services.network.wal import WriteAheadLog
+from fabric_token_sdk_tpu.services.ttx import PipelinedSubmitter, Transaction
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+from test_orderer import build_env, fab_env, issue_to, manual_transfer
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(scope="module")
+def zk_pp():
+    return setup(base=4, exponent=2, rng=random.Random(0xF75))
+
+
+def _counter(name):
+    return mx.REGISTRY.counter(name).value
+
+
+def _wal_content(path):
+    """Journal records minus the wall-clock stamp: the deterministic
+    durable content two engines must agree on byte for byte."""
+    return [
+        {k: v for k, v in loads(raw).items() if k != "ts"}
+        for raw in WriteAheadLog(path).replay()
+    ]
+
+
+def _policy(pipeline, **kw):
+    kw.setdefault("max_block_txs", 2)
+    return BlockPolicy(pipeline=pipeline, **kw)
+
+
+# ===================================================================
+# Differential: pipelined engine == sequential engine
+# ===================================================================
+
+
+def test_pipelined_vs_sequential_differential_fabtoken(tmp_path):
+    """Same corpus (including an intra-block double spend) through both
+    engines: identical verdicts, identical ledger state, identical WAL
+    contents (modulo timestamps)."""
+    network, parties, issuer, alice, bob = fab_env(BlockPolicy(max_block_txs=8))
+    alice_p = parties["alice-node"]
+    seed = issue_to(parties, alice, [5, 5, 7], "seed")
+    ids = alice_p.vault.token_ids()
+    reqs = [
+        manual_transfer(alice_p, ids[0], 5, bob.recipient_identity(), "d-a"),
+        manual_transfer(alice_p, ids[0], 5, bob.recipient_identity(), "d-b"),
+        manual_transfer(alice_p, ids[1], 5, bob.recipient_identity(), "d-c"),
+        manual_transfer(alice_p, ids[2], 7, bob.recipient_identity(), "d-d"),
+    ]
+    batch = [r.to_bytes() for r in reqs]
+    pp = network.validator.driver.pp
+
+    def run(pipeline):
+        wal = str(tmp_path / f"wal-{int(pipeline)}.wal")
+        net = Network(
+            RequestValidator(FabTokenDriver(pp)),
+            policy=_policy(pipeline),
+            wal_path=wal,
+        )
+        assert (net._engine is not None) == pipeline
+        ev0 = net.submit(seed.request.to_bytes())
+        assert ev0.status == TxStatus.VALID
+        events = net.submit_many(batch)
+        from fabric_token_sdk_tpu.models.token import ID
+
+        state = {
+            a: net.exists(ID(a, 0)) for a in ("d-a", "d-b", "d-c", "d-d")
+        }
+        return (
+            [(e.tx_id, e.status, e.message) for e in events],
+            state,
+            net.height(),
+            _wal_content(wal),
+        )
+
+    piped = run(pipeline=True)
+    seq = run(pipeline=False)
+    assert piped == seq
+    # the conflicting tx really was invalidated, in both
+    assert piped[0][1][1] == TxStatus.INVALID
+    # 1 seed block + ceil(4/2) blocks, strict height order in both
+    assert piped[2] == 3
+    # the journals carry the same heights in the same order
+    assert [r["height"] for r in piped[3]] == [0, 1, 2]
+
+
+def test_zk_pipelined_blocks_differential_and_metrics(zk_pp, tmp_path):
+    """8 same-shape zkatdlog transfers streamed as two 4-tx blocks
+    through the pipelined engine: verdicts, state and WAL contents match
+    the sequential engine; the batched device plane carried every proof
+    in both; the pipeline counters moved."""
+    network, parties, issuer, alice, bob = build_env(
+        lambda: ZKATDLogDriver(zk_pp), BlockPolicy(max_block_txs=16)
+    )
+    alice_p = parties["alice-node"]
+    seed = issue_to(parties, alice, [5] * 8, "zkp-seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"zkp-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    batch = [r.to_bytes() for r in reqs]
+
+    def run(pipeline):
+        wal = str(tmp_path / f"zk-wal-{int(pipeline)}.wal")
+        net = Network(
+            RequestValidator(ZKATDLogDriver(zk_pp)),
+            policy=_policy(pipeline, max_block_txs=4, min_batch=2),
+            wal_path=wal,
+        )
+        before_bt = _counter("batch.transfer.txs")
+        ev0 = net.submit(seed.request.to_bytes())
+        assert ev0.status == TxStatus.VALID
+        events = net.submit_many(batch)
+        assert _counter("batch.transfer.txs") - before_bt == 8
+        return (
+            [(e.tx_id, e.status, e.message) for e in events],
+            net.height(),
+            _wal_content(wal),
+        )
+
+    blocks_before = _counter("orderer.pipeline.blocks")
+    piped = run(pipeline=True)
+    piped_blocks = _counter("orderer.pipeline.blocks") - blocks_before
+    seq = run(pipeline=False)
+    assert piped == seq
+    assert all(s == TxStatus.VALID for _t, s, _m in piped[0])
+    assert piped[1] == 3  # seed block + 2 transfer blocks, height-ordered
+    # the transfer blocks (and the seed block) rode the engine
+    assert piped_blocks >= 3
+    # the sequential run routed around it entirely
+    assert _counter("orderer.pipeline.blocks") - blocks_before == piped_blocks
+
+
+def test_pipelined_batch_verify_fault_degrades_identically(zk_pp):
+    """An injected `batch.verify` fault inside a PIPELINED block falls
+    back to host validation with identical verdicts — the degrade chain
+    survives the overlap."""
+
+    def run(inject):
+        net, parties, issuer, alice, bob = build_env(
+            lambda: ZKATDLogDriver(zk_pp),
+            BlockPolicy(max_block_txs=8, min_batch=2, pipeline=True),
+        )
+        assert net._engine is not None
+        issue_to(parties, alice, [5, 5], f"pf-seed-{int(inject)}")
+        alice_p = parties["alice-node"]
+        reqs = [
+            manual_transfer(alice_p, tid, 5, bob.recipient_identity(),
+                            f"pf-{int(inject)}-{i}")
+            for i, tid in enumerate(alice_p.vault.token_ids())
+        ]
+        if inject:
+            faults.arm("batch.verify", "error", count=1)
+        try:
+            events = net.submit_many([r.to_bytes() for r in reqs])
+        finally:
+            faults.clear()
+        return [e.status for e in events], parties["bob-node"].balance("USD")
+
+    errors_before = _counter("ledger.block.batch_errors")
+    host_before = _counter("ledger.validate.host")
+    injected = run(inject=True)
+    assert _counter("ledger.block.batch_errors") - errors_before == 1
+    assert _counter("ledger.validate.host") - host_before == 2
+    clean = run(inject=False)
+    assert injected == clean == ([TxStatus.VALID, TxStatus.VALID], 10)
+
+
+def test_pipeline_kill_switch_restores_sequential(monkeypatch):
+    """FTS_BLOCK_PIPELINE=0 beats even an explicit pipeline=True policy:
+    no engine, no worker, no overlap_s in the breakdown — the exact old
+    path."""
+    monkeypatch.setenv("FTS_BLOCK_PIPELINE", "0")
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=4, pipeline=True)
+    )
+    assert network._engine is None
+    issue_to(parties, alice, [5], "ks-seed")
+    assert "overlap_s" not in network.last_block["breakdown"]
+
+
+def test_pipelined_commit_error_reaches_the_waiter(tmp_path):
+    """A commit-stage exception on the worker thread (injected WAL
+    fault) re-raises on the waiter's stack — the sequential engine's
+    driving-thread contract — and nothing durable is recorded."""
+    wal = str(tmp_path / "err.wal")
+    network, parties, issuer, alice, bob = fab_env(BlockPolicy(max_block_txs=8))
+    pp = network.validator.driver.pp
+    net = Network(
+        RequestValidator(FabTokenDriver(pp)),
+        policy=_policy(True, max_block_txs=8),
+        wal_path=wal,
+    )
+    issue_to(parties, alice, [5], "seed")
+    alice_p = parties["alice-node"]
+    tid = alice_p.vault.token_ids()[0]
+    req = manual_transfer(alice_p, tid, 5, bob.recipient_identity(), "we-pay")
+    faults.arm("wal.append", "error", count=1)
+    try:
+        with pytest.raises(faults.FaultInjected):
+            net.submit(req.to_bytes())
+    finally:
+        faults.clear()
+    assert net.status("we-pay") is None and net.height() == 0
+    # fault expended: an identical resubmission commits exactly once
+    assert net.submit(req.to_bytes()).status == TxStatus.INVALID  # no seed
+    assert net.height() == 1
+
+
+def test_pipelined_height_order_under_concurrency():
+    """Concurrent submitters through the engine: every tx lands in
+    exactly one block, block numbers are strictly sequential, balances
+    conserve."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=2, pipeline=True)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2] * 6, "seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"hc-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    h0 = network.height()
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(reqs))
+
+    def worker(rb):
+        barrier.wait()
+        ev = network.submit(rb)
+        with lock:
+            results.append(ev)
+
+    threads = [
+        threading.Thread(target=worker, args=(r.to_bytes(),)) for r in reqs
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(e.status == TxStatus.VALID for e in results)
+    committed = []
+    for i in range(h0, network.height()):
+        block = network.block(i)
+        assert block.number == i  # strict height order at the merge point
+        committed.extend(block.txs)
+    assert sorted(committed) == sorted(f"hc-{i}" for i in range(len(reqs)))
+    assert parties["bob-node"].balance("USD") == 12
+
+
+# ===================================================================
+# Overlap accounting + condition-variable waits
+# ===================================================================
+
+
+def test_overlap_recorded_when_commit_is_slow():
+    """With an artificially slow commit stage, block N+1's verify runs
+    almost entirely inside block N's commit window: `overlap_s` lands in
+    the breakdown and the overlap gauge/histogram move."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=1, pipeline=True)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2, 2, 2], "seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"ov-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    hist = mx.REGISTRY.histogram("orderer.pipeline.overlap.seconds")
+    count_before, sum_before = hist.count, hist.sum
+    faults.arm("ledger.commit_block", "delay", delay_s=0.15)
+    try:
+        events = network.submit_many([r.to_bytes() for r in reqs])
+    finally:
+        faults.clear()
+    assert all(e.status == TxStatus.VALID for e in events)
+    assert hist.count - count_before >= 3  # one observation per block
+    # at least one later block's verify ran inside an earlier block's
+    # commit window (the first block of a burst never can)
+    assert hist.sum - sum_before > 0
+    # the breakdown carries the overlap leg in pipelined mode
+    assert "overlap_s" in network.last_block["breakdown"]
+
+
+def test_waiters_park_without_burning_cpu():
+    """Satellite: waiters on an in-flight block wait on a condition
+    variable, not a busy-race on the commit lock — process CPU time
+    during a slow commit stays far below wall time."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=8, pipeline=True)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2, 2], "seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"cw-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    subs = [network.submit_async(r.to_bytes()) for r in reqs]
+    faults.arm("ledger.commit_block", "delay", delay_s=0.5)
+    waiters_done = []
+
+    def waiter(s):
+        waiters_done.append(s.result(timeout=30))
+
+    try:
+        threads = [
+            threading.Thread(target=waiter, args=(s,)) for s in subs
+        ]
+        wall0, cpu0 = time.monotonic(), time.process_time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall, cpu = time.monotonic() - wall0, time.process_time() - cpu0
+    finally:
+        faults.clear()
+    assert all(e.status == TxStatus.VALID for e in waiters_done)
+    assert wall >= 0.45  # the injected delay really gated the block
+    # a busy-race would burn ~wall seconds of CPU across the waiters
+    assert cpu < 0.6 * wall, f"waiters burned {cpu:.2f}s CPU in {wall:.2f}s"
+
+
+# ===================================================================
+# Backpressure: admission control + exactly-once retry
+# ===================================================================
+
+
+def test_backpressure_rejects_before_ordering():
+    """A full ordering queue rejects with the typed error BEFORE the tx
+    enters ordering: nothing committed, nothing recorded, a later retry
+    lands exactly once."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=8, queue_max=2)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2, 2, 2], "seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"bp-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    rejects_before = _counter("orderer.backpressure.rejects")
+    s0 = network.submit_async(reqs[0].to_bytes())
+    s1 = network.submit_async(reqs[1].to_bytes())
+    with pytest.raises(Backpressure):
+        network.submit_async(reqs[2].to_bytes())
+    assert _counter("orderer.backpressure.rejects") - rejects_before == 1
+    assert network.status("bp-2") is None  # never entered ordering
+    network.flush()
+    assert s0.result().status == TxStatus.VALID
+    assert s1.result().status == TxStatus.VALID
+    # retry after drain: exactly one commit, no resubmission dedup needed
+    resub_before = _counter("network.submit.resubmissions")
+    assert network.submit(reqs[2].to_bytes()).status == TxStatus.VALID
+    assert _counter("network.submit.resubmissions") == resub_before
+
+
+def test_submit_many_is_cooperative_under_backpressure():
+    """A batch larger than the queue bound lands WHOLE: the batch
+    submitter drains its own queue on each rejection instead of
+    stranding the enqueued prefix."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=2, queue_max=2)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2] * 6, "seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 2, bob.recipient_identity(), f"co-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+    flushes_before = _counter("orderer.backpressure.flushes")
+    events = network.submit_many([r.to_bytes() for r in reqs])
+    assert [e.status for e in events] == [TxStatus.VALID] * 6
+    assert _counter("orderer.backpressure.flushes") > flushes_before
+    assert parties["bob-node"].balance("USD") == 12
+
+
+def test_remote_backpressure_exactly_once_with_backoff():
+    """Satellite acceptance: a remote client that receives the typed
+    `Backpressure` retries with backoff and lands EXACTLY one commit —
+    counter-asserted (one valid tx, zero dedup'd resubmissions)."""
+    network, parties, issuer, alice, bob = fab_env(
+        BlockPolicy(max_block_txs=8, queue_max=1)
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [2, 5], "seed")
+    ids = alice_p.vault.token_ids()
+    blocker = manual_transfer(alice_p, ids[0], 2, bob.recipient_identity(),
+                              "rbp-blocker")
+    payed = manual_transfer(alice_p, ids[1], 5, bob.recipient_identity(),
+                            "rbp-pay")
+    server = LedgerServer(network=network).start()
+    client = RemoteNetwork(server.address, retries=8, backoff_s=0.05)
+    try:
+        # fill the 1-deep queue so the wire submit is rejected
+        blocked = network.submit_async(blocker.to_bytes())
+        retry_before = _counter("remote.retry.backpressure")
+        valid_before = _counter("network.tx.valid")
+        resub_before = _counter("network.submit.resubmissions")
+
+        def drain_later():
+            time.sleep(0.25)
+            network.flush()
+
+        t = threading.Thread(target=drain_later)
+        t.start()
+        event = client.submit(payed.to_bytes())
+        t.join()
+        assert blocked.result(timeout=10).status == TxStatus.VALID
+    finally:
+        client.close()
+        server.stop()
+    assert event.status == TxStatus.VALID
+    assert _counter("remote.retry.backpressure") - retry_before >= 1
+    # exactly once: both txs committed once, nothing was dedup'd
+    assert _counter("network.tx.valid") - valid_before == 2
+    assert _counter("network.submit.resubmissions") == resub_before
+    assert network.status("rbp-pay").status == TxStatus.VALID
+
+
+# ===================================================================
+# Prove→submit overlap: the pipelined ttx client path
+# ===================================================================
+
+
+def test_pipelined_submitter_overlaps_prove_with_submit():
+    """While group k is in flight (slow commit), the caller is already
+    building group k+1: results come back in order, all valid, and the
+    overlap gauge records that proving ran during submission."""
+    network, parties, issuer, alice, bob = fab_env(BlockPolicy(max_block_txs=8))
+    issuer_p = parties["issuer-node"]
+
+    def builder(gi):
+        def build():
+            time.sleep(0.05)  # stands in for BatchedTransferProver work
+            out = []
+            for j in range(2):
+                t = Transaction(issuer_p, f"ps-{gi}-{j}")
+                t.issue("issuer", "USD", [1 + gi],
+                        [alice.recipient_identity()], anonymous=False)
+                t.collect_endorsements(None)
+                out.append(t.request.to_bytes())
+            return out
+
+        return build
+
+    groups_before = _counter("ttx.pipeline.groups")
+    faults.arm("ledger.commit_block", "delay", delay_s=0.1)
+    try:
+        results = PipelinedSubmitter(network).run(
+            [builder(i) for i in range(3)]
+        )
+    finally:
+        faults.clear()
+    assert len(results) == 3
+    for gi, events in enumerate(results):
+        assert [e.tx_id for e in events] == [f"ps-{gi}-{j}" for j in range(2)]
+        assert all(e.status == TxStatus.VALID for e in events)
+    assert _counter("ttx.pipeline.groups") - groups_before == 3
+    assert mx.REGISTRY.gauge("ttx.pipeline.overlap_frac").value > 0
+
+
+def test_pipelined_submitter_retries_backpressure():
+    """A `Backpressure` raised by the network is retried with backoff
+    inside the submit worker — the pipeline never loses a group."""
+    network, parties, issuer, alice, bob = fab_env(BlockPolicy(max_block_txs=8))
+    issuer_p = parties["issuer-node"]
+    calls = {"n": 0}
+    real = network.submit_many
+
+    def flaky(requests):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Backpressure("synthetic queue-full")
+        return real(requests)
+
+    network.submit_many = flaky
+    bp_before = _counter("ttx.pipeline.backpressure")
+
+    def build():
+        t = Transaction(issuer_p, "psb-0")
+        t.issue("issuer", "USD", [3], [alice.recipient_identity()],
+                anonymous=False)
+        t.collect_endorsements(None)
+        return [t.request.to_bytes()]
+
+    results = PipelinedSubmitter(network, backoff_s=0.01).run([build])
+    assert [e.status for e in results[0]] == [TxStatus.VALID]
+    assert _counter("ttx.pipeline.backpressure") - bp_before == 1
+
+
+# ===================================================================
+# Soak observatory plumbing: schema + ftstop gates + top rendering
+# ===================================================================
+
+
+def _ftstop():
+    sys.path.insert(0, os.path.join(REPO, "cmd"))
+    try:
+        import ftstop
+    finally:
+        sys.path.pop(0)
+    return ftstop
+
+
+def _full_result(**over):
+    import bench
+
+    r = bench.headline_result(
+        rate=100.0, platform="cpu", batch=8, runs=1, warm_s=1.0,
+        provegen_s=2.0, provegen_host_s=0.5, prove_txs=4, prove_rate=2.0,
+        host_rate=1.0, prove_degraded=False, setup_s=0.1, stage_warmup_s=5.0,
+    )
+    r.update(over)
+    return r
+
+
+def _soak_section(**over):
+    s = {"steady_txs_per_s": 120.0, "p99_finality_s": 0.8,
+         "queue_depth_max": 40, "backpressure_rejects": 3}
+    s.update(over)
+    return s
+
+
+def test_soak_schema_validates():
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    r = _full_result()
+    r["soak"] = _soak_section()
+    assert benchschema.validate_result(r) == []
+    assert benchschema.validate_soak(r["soak"]) == []
+    # p99 is nullable (a soak that committed nothing)
+    assert benchschema.validate_soak(_soak_section(p99_finality_s=None)) == []
+    # malformed sections are named
+    assert benchschema.validate_soak("fast")
+    assert benchschema.validate_soak({})
+    assert benchschema.validate_soak(_soak_section(steady_txs_per_s=-1.0))
+    assert benchschema.validate_soak(_soak_section(backpressure_rejects=0.5))
+    r["soak"] = {"steady_txs_per_s": 1.0}
+    assert benchschema.validate_result(r)  # incomplete soak fails the result
+
+
+def test_ftstop_soak_gate(tmp_path, capsys):
+    """`ftstop compare --soak` gates steady-state tx/s (drop = regress)
+    and p99 finality (growth = regress) against the median of prior
+    soak-carrying rounds."""
+    import bench
+
+    ftstop = _ftstop()
+
+    def history(rows, sub):
+        path = str(tmp_path / sub / "hist.jsonl")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        for soak in rows:
+            r = _full_result()
+            if soak is not None:
+                r["soak"] = soak
+            bench.append_history(r, path=path)
+        return path
+
+    # steady numbers -> ok; rounds without a soak section are skipped
+    path = history(
+        [_soak_section(), None, _soak_section(steady_txs_per_s=118.0)], "a"
+    )
+    assert ftstop.main(["compare", "--history", path, "--soak"]) == 0
+    out = capsys.readouterr().out
+    assert "soak.steady_txs_per_s" in out and "OK" in out
+
+    # throughput collapse -> regression, rc 1; --no-fail reports only
+    path = history(
+        [_soak_section(), _soak_section(steady_txs_per_s=50.0)], "b"
+    )
+    assert ftstop.main(["compare", "--history", path, "--soak"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert ftstop.main(
+        ["compare", "--history", path, "--soak", "--no-fail"]
+    ) == 0
+
+    # p99 finality blow-up alone is also a regression
+    path = history(
+        [_soak_section(), _soak_section(p99_finality_s=2.5)], "c"
+    )
+    assert ftstop.main(["compare", "--history", path, "--soak"]) == 1
+    capsys.readouterr()
+
+    # fewer than two soak-carrying rounds -> rc 2
+    path = history([None, _soak_section()], "d")
+    assert ftstop.main(["compare", "--history", path, "--soak"]) == 2
+
+
+def test_ftstop_top_renders_queue_trend_and_backpressure():
+    ftstop = _ftstop()
+    health = {"uptime_s": 5.0, "height": 3, "queue_depth": 7, "inflight": 9}
+    prev = {
+        "counters": {"network.tx.valid": 10,
+                     "orderer.backpressure.rejects": 2},
+        "gauges": {"orderer.queue.depth": 4},
+    }
+    snap = {
+        "counters": {"network.tx.valid": 30,
+                     "orderer.backpressure.rejects": 6},
+        "gauges": {"orderer.queue.depth": 7},
+    }
+    row = ftstop.format_row(health, snap, prev, 2.0)
+    assert "queue=7(+3)" in row
+    assert "bp/s=2.00" in row
+    assert "tx/s=10.00" in row
+    # no previous poll: trend and rates degrade to placeholders
+    row0 = ftstop.format_row(health, snap, None, None)
+    assert "queue=7 " in row0 + " " and "bp/s=-" in row0
+
+
+def test_bench_soak_phase_smoke(monkeypatch):
+    """The bench soak phase end to end (tiny budget): a parsed section
+    with steady tx/s, client p99, bounded queue depth — schema-valid."""
+    import bench
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    monkeypatch.setenv("FTS_BENCH_SOAK_S", "1.5")
+    monkeypatch.setenv("FTS_BENCH_SOAK_CLIENTS", "2")
+    monkeypatch.setenv("FTS_BENCH_SOAK_GROUP", "4")
+    monkeypatch.setenv("FTS_BENCH_SOAK_QUEUE_MAX", "16")
+
+    class _HB:
+        def set_phase(self, *a, **k):
+            pass
+
+    soak = bench._soak(_HB())
+    assert benchschema.validate_soak(soak) == []
+    assert soak["steady_txs_per_s"] > 0
+    assert soak["txs"] > 0
+    assert soak["p99_finality_s"] > 0
+    assert soak["queue_depth_max"] <= 16
