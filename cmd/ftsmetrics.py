@@ -174,6 +174,29 @@ def show(path: str, prometheus: bool = False) -> None:
             f" remote_retries={retries}"
         )
 
+    # one-line state-plane health: vault traffic (tokens held / stored /
+    # spent / certs dropped, journal appends+failures) and the selector's
+    # p99 + lock-contention rate under concurrent spenders
+    v_stored = ctr.get("vault.tokens.stored", 0)
+    v_spent = ctr.get("vault.tokens.spent", 0)
+    s_busy = ctr.get("selector.lock.busy", 0)
+    s_acq = ctr.get("selector.lock.acquired", 0)
+    if v_stored or v_spent or s_acq or ctr.get("vault.recoveries", 0):
+        sel_h = d.get("histograms", {}).get("selector.select.seconds", {})
+        busy_rate = s_busy / (s_busy + s_acq) if (s_busy + s_acq) else 0.0
+        held = d.get("gauges", {}).get("vault.tokens.held", 0)
+        print(
+            f"state summary: tokens_held={int(held)}"
+            f" stored={v_stored} spent={v_spent}"
+            f" certs_dropped={ctr.get('vault.certs.dropped', 0)}"
+            f" vault_appends={ctr.get('vault.appends', 0)}"
+            f"(+{ctr.get('vault.append_failures', 0)} failed)"
+            f" recoveries={ctr.get('vault.recoveries', 0)}"
+            f" selector_p99="
+            + ("-" if not sel_h.get("count") else _fmt_s(sel_h.get("p99", 0.0)))
+            + f" lock_busy_rate={busy_rate:.2f}"
+        )
+
     # one-line live-ops summary: queue/memory state at flush time plus
     # the latency quantiles the ops plane serves (p50/p95/p99)
     g = d.get("gauges", {})

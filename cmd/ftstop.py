@@ -6,6 +6,7 @@ Usage:
     python cmd/ftstop.py compare --history BENCH_history.jsonl [--last N]
     python cmd/ftstop.py compare --history BENCH_history.jsonl --scaling
     python cmd/ftstop.py compare --history BENCH_history.jsonl --soak
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --state
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
@@ -312,43 +313,40 @@ SOAK_METRICS = (
 )
 
 
-def compare_soak(args) -> int:
-    """The soak observatory: gate on the sustained-load numbers —
-    steady-state tx/s regresses when it drops, p99 finality when it
-    grows — against the per-metric MEDIAN of the prior soak-carrying
-    history rounds (same pattern as `--scaling`). Exit 1 on regression
-    (CI-gateable; `--no-fail` disables), 2 when fewer than two rounds
-    carry a soak section."""
+def _gate_sections(args, section_name, section_of, metrics,
+                   header) -> int:
+    """Shared engine of the section observatories (`--soak`/`--state`):
+    collect the named section from every schema-valid history round,
+    gate the latest against the per-metric MEDIAN of the prior
+    section-carrying rounds with direction-aware threshold verdicts.
+    Exit 1 on regression (CI-gateable; `--no-fail` disables), 2 when
+    fewer than two rounds carry the section or nothing compares."""
     from fabric_token_sdk_tpu.utils import benchschema
 
     rows = benchschema.load_history(args.history)
-    soaks = []
+    sections = []
     for row in rows:
         result = benchschema.extract_result(row)
         if not result or benchschema.validate_result(result):
             continue
-        s = soak_of(result)
+        s = section_of(result)
         if s:
-            soaks.append(s)
+            sections.append(s)
     if args.last:
-        soaks = soaks[-args.last:]
-    if len(soaks) < 2:
+        sections = sections[-args.last:]
+    if len(sections) < 2:
         print(
-            "ftstop compare --soak: need at least 2 history rounds with a "
-            f"soak section, found {len(soaks)}", file=sys.stderr,
+            f"ftstop compare --{section_name}: need at least 2 history "
+            f"rounds with a {section_name} section, found {len(sections)}",
+            file=sys.stderr,
         )
         return 2
-    latest, prior = soaks[-1], soaks[:-1]
-    print(
-        f"== soak, latest round (threshold ±{args.threshold:.0%}): "
-        f"steady={latest['steady_txs_per_s']:g}tx/s "
-        f"p99_finality={latest.get('p99_finality_s')} "
-        f"queue_max={latest['queue_depth_max']:g} "
-        f"backpressure={latest['backpressure_rejects']}"
-    )
+    latest, prior = sections[-1], sections[:-1]
+    print(f"== {header(latest)}  (threshold ±{args.threshold:.0%})")
     regressions = 0
     compared = 0
-    for key, direction in SOAK_METRICS:
+    width = max(len(k) for k, _d in metrics)
+    for key, direction in metrics:
         base_vals = [s[key] for s in prior if _num(s.get(key))]
         new = latest.get(key)
         if not base_vals or not _num(new):
@@ -365,15 +363,65 @@ def compare_soak(args) -> int:
         if verdict == "regression":
             regressions += 1
         print(
-            f"{verdict.upper():<12} soak.{key:<20} "
+            f"{verdict.upper():<12} {section_name}.{key:<{width}} "
             f"{base:g} -> {new:g}  ({rel:+.1%}, "
             f"median of {len(base_vals)} prior round(s))"
         )
     if not compared:
-        print("ftstop compare --soak: no comparable soak metrics",
-              file=sys.stderr)
+        print(f"ftstop compare --{section_name}: no comparable "
+              f"{section_name} metrics", file=sys.stderr)
         return 2
     return 1 if regressions and not args.no_fail else 0
+
+
+def compare_soak(args) -> int:
+    """The soak observatory: gate on the sustained-load numbers —
+    steady-state tx/s regresses when it drops, p99 finality when it
+    grows — against the per-metric MEDIAN of the prior soak-carrying
+    history rounds (same pattern as `--scaling`)."""
+    return _gate_sections(
+        args, "soak", soak_of, SOAK_METRICS,
+        lambda s: (
+            f"soak, latest round: steady={s['steady_txs_per_s']:g}tx/s "
+            f"p99_finality={s.get('p99_finality_s')} "
+            f"queue_max={s['queue_depth_max']:g} "
+            f"backpressure={s['backpressure_rejects']}"
+        ),
+    )
+
+
+def state_of(result: dict) -> Optional[dict]:
+    """The `state` section of one schema-valid bench result, or None.
+    (Callers filter through `validate_result` first, which already
+    field-checks any dict-typed state section.)"""
+    s = result.get("state")
+    return s if isinstance(s, dict) else None
+
+
+# (state field, direction): +1 = higher is better, -1 = lower is better
+STATE_METRICS = (
+    ("selector_p99_s", -1),
+    ("populate_tokens_per_s", +1),
+    ("recover_tokens_per_s", +1),
+)
+
+
+def compare_state(args) -> int:
+    """The state-plane observatory: gate the client state plane's scale
+    numbers — selection p99 under concurrent spenders regresses when it
+    GROWS, steady populate/recover throughput when it DROPS — against
+    the per-metric MEDIAN of the prior state-carrying history rounds
+    (same contract as `--scaling`/`--soak`)."""
+    return _gate_sections(
+        args, "state", state_of, STATE_METRICS,
+        lambda s: (
+            f"state plane, latest round: tokens={s['tokens']} "
+            f"selector_p99={s['selector_p99_s']:g}s "
+            f"populate={s['populate_tokens_per_s']:g}tok/s "
+            f"recover={s['recover_tokens_per_s']:g}tok/s "
+            f"rss_hw={s['rss_high_water_mb']:g}MB"
+        ),
+    )
 
 
 def baseline_of(records: List[dict]) -> dict:
@@ -485,14 +533,22 @@ def main(argv=None) -> int:
                        help="history mode: only consider the last N rounds")
     p_cmp.add_argument("--threshold", type=float, default=0.1,
                        help="relative change that counts as a verdict")
-    p_cmp.add_argument("--scaling", action="store_true",
-                       help="gate on the throughput-vs-devices curve: "
-                            "per-device efficiency at the max device count "
-                            "(history mode only)")
-    p_cmp.add_argument("--soak", action="store_true",
-                       help="gate on the sustained-load soak: steady-state "
-                            "tx/s and p99 finality vs the median of prior "
-                            "soak-carrying rounds (history mode only)")
+    # one gate mode per invocation: a silently-ignored second flag would
+    # let its regression pass CI unreported
+    p_gate = p_cmp.add_mutually_exclusive_group()
+    p_gate.add_argument("--scaling", action="store_true",
+                        help="gate on the throughput-vs-devices curve: "
+                             "per-device efficiency at the max device count "
+                             "(history mode only)")
+    p_gate.add_argument("--soak", action="store_true",
+                        help="gate on the sustained-load soak: steady-state "
+                             "tx/s and p99 finality vs the median of prior "
+                             "soak-carrying rounds (history mode only)")
+    p_gate.add_argument("--state", action="store_true",
+                        help="gate on the state-plane scale numbers: selector "
+                             "p99 (growth) and populate/recover throughput "
+                             "(drop) vs the median of prior state-carrying "
+                             "rounds (history mode only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
@@ -507,6 +563,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --soak needs --history")
         return compare_soak(args)
+    if args.state:
+        if not args.history:
+            ap.error("compare --state needs --history")
+        return compare_state(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

@@ -1,1 +1,8 @@
-from .selector import InsufficientFunds, Selector, SelectorManager  # noqa: F401
+from .selector import (  # noqa: F401
+    InsufficientFunds,
+    Locker,
+    Selector,
+    SelectorManager,
+    SelectorTimeout,
+    ShardedLocker,
+)

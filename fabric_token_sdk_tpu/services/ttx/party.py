@@ -21,14 +21,21 @@ from ..vault.vault import Vault
 
 class Party:
     def __init__(self, name: str, driver: Driver, network: Network,
-                 auditor_identity: bytes = b"", rng=None, db_path: str = ":memory:"):
+                 auditor_identity: bytes = b"", rng=None,
+                 db_path: str = ":memory:",
+                 vault_path: Optional[str] = None):
         self.name = name
         self.driver = driver
         self.network = network
         self.rng = rng
         self.wallets = WalletRegistry()
         self.tms = ManagementService(driver, self.wallets, auditor_identity, rng)
-        self.vault = Vault(driver, self._owns_identity)
+        if vault_path:
+            # crash-safe vault: recover whatever the journal + snapshot
+            # hold (a fresh path recovers to empty) and keep journaling
+            self.vault = Vault.recover(vault_path, driver, self._owns_identity)
+        else:
+            self.vault = Vault(driver, self._owns_identity)
         self.selectors = SelectorManager(self.vault)
         self.db = TransactionDB(db_path)
         network.subscribe(self.vault.on_finality)

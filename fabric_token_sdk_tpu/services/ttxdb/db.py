@@ -41,25 +41,62 @@ class TransactionRecord:
 class TransactionDB:
     """One DB per party (':memory:' or a file path for persistence)."""
 
+    _TRANSACTIONS_DDL = """
+        CREATE TABLE IF NOT EXISTS transactions (
+            tx_id TEXT PRIMARY KEY, tx_type TEXT, sender_eid TEXT,
+            recipient_eid TEXT, token_type TEXT, amount TEXT,
+            status TEXT, timestamp REAL
+        );
+    """
+
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._mu = threading.Lock()
         with self._mu:
+            # WAL journaling: crash-consistent file DBs with concurrent
+            # readers never blocked by a writer (a no-op for ':memory:')
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._migrate_legacy_transactions()
             self._conn.executescript(
-                """
-                CREATE TABLE IF NOT EXISTS transactions (
-                    tx_id TEXT, tx_type TEXT, sender_eid TEXT,
-                    recipient_eid TEXT, token_type TEXT, amount TEXT,
-                    status TEXT, timestamp REAL
-                );
+                self._TRANSACTIONS_DDL
+                + """
                 CREATE TABLE IF NOT EXISTS movements (
                     tx_id TEXT, wallet_eid TEXT, token_type TEXT,
                     amount TEXT, direction TEXT, status TEXT
                 );
-                CREATE INDEX IF NOT EXISTS tx_idx ON transactions(tx_id);
+                CREATE INDEX IF NOT EXISTS mov_wallet_idx
+                    ON movements(wallet_eid, direction, status);
                 """
             )
             self._conn.commit()
+
+    def _migrate_legacy_transactions(self) -> None:
+        """An on-disk DB created before `tx_id` became the PRIMARY KEY
+        has a plain table — `CREATE TABLE IF NOT EXISTS` never retrofits
+        the constraint, and the upsert's ON CONFLICT would raise.
+        Rebuild it in place, keeping the FIRST row per tx_id (the row
+        the old `status()` read order returned) and dropping the legacy
+        `tx_idx` index the PK makes redundant. The whole rebuild runs in
+        ONE transaction (sqlite DDL is transactional), so a crash
+        mid-migration rolls back to the untouched legacy table instead
+        of stranding history in a half-renamed one."""
+        info = self._conn.execute("PRAGMA table_info(transactions)").fetchall()
+        if not info or any(r[1] == "tx_id" and r[5] for r in info):
+            return  # no table yet, or already PK-keyed
+        self._conn.executescript(
+            "BEGIN;"
+            "ALTER TABLE transactions RENAME TO transactions_legacy;"
+            + self._TRANSACTIONS_DDL
+            # rowid order = insertion order: OR IGNORE keeps the first
+            # row per tx_id, matching the old duplicate-read semantics
+            + """
+            INSERT OR IGNORE INTO transactions
+                SELECT * FROM transactions_legacy;
+            DROP TABLE transactions_legacy;
+            DROP INDEX IF EXISTS tx_idx;
+            COMMIT;
+            """
+        )
 
     # ------------------------------------------------------------ writes
 
@@ -67,8 +104,16 @@ class TransactionDB:
                         recipient: str, token_type: str, amount: int,
                         status: str = "Pending") -> None:
         with self._mu:
+            # tx_id is the PRIMARY KEY: a resubmitted tx UPSERTS its row
+            # (fresh status/timestamp) instead of inserting a duplicate
+            # that `status()` would silently shadow
             self._conn.execute(
-                "INSERT INTO transactions VALUES (?,?,?,?,?,?,?,?)",
+                "INSERT INTO transactions VALUES (?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(tx_id) DO UPDATE SET "
+                "tx_type=excluded.tx_type, sender_eid=excluded.sender_eid, "
+                "recipient_eid=excluded.recipient_eid, "
+                "token_type=excluded.token_type, amount=excluded.amount, "
+                "status=excluded.status, timestamp=excluded.timestamp",
                 (tx_id, tx_type.value, sender, recipient, token_type,
                  str(amount), status, time.time()),
             )

@@ -65,6 +65,7 @@ OPTIONAL = {
     "wal_overhead_frac": _NUM,
     "scaling": list,  # throughput-vs-devices curve (validated per row)
     "soak": dict,  # sustained-load soak section (validated per field)
+    "state": dict,  # state-plane scale section (validated per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
 
@@ -91,6 +92,51 @@ def validate_soak(soak) -> List[str]:
     if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
         problems.append("soak.steady_txs_per_s is negative")
     return problems
+
+# the state-plane scale section (`state` field, bench `state_scale`
+# phase): synthetic token count populated into a persistent vault,
+# populate/recover wall time + throughput, p99 selection latency under
+# concurrent select+spend threads, and the RSS high-water the phase
+# reached (sysmon) — the numbers `ftstop compare --state` gates
+STATE_REQUIRED = {
+    "tokens": int,
+    "populate_s": _NUM,
+    "populate_tokens_per_s": _NUM,
+    "recover_s": _NUM,
+    "recover_tokens_per_s": _NUM,
+    "selector_p99_s": _NUM,
+    "rss_high_water_mb": _NUM,
+}
+
+# type-checked when present in a state section. The calibration pair is
+# measured by a PURE single-thread no-spend selection pass at both sizes
+# (selection cost, not contention): `sublinear_ratio` =
+# p99(pure, full size) / p99(pure, small size) — the recorded witness
+# that indexed selection stays sub-linear in vault size, while
+# `selector_p99_s` stays the concurrent select+spend headline.
+STATE_OPTIONAL = {
+    "selects": int,
+    "spends": int,
+    "threads": int,
+    "selector_p99_small_s": _NULLABLE_NUM,  # pure p99 at the small size
+    "small_tokens": int,
+    "sublinear_ratio": _NULLABLE_NUM,  # pure p99(full) / pure p99(small)
+}
+
+
+def validate_state(state) -> List[str]:
+    """Schema problems of one `state` section (empty list = valid)."""
+    if not isinstance(state, dict):
+        return [f"state is {type(state).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, state, STATE_REQUIRED, required=True)
+    _check(problems, state, STATE_OPTIONAL, required=False)
+    for key in ("tokens", "selector_p99_s"):
+        v = state.get(key)
+        if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
+            problems.append(f"state.{key} is negative")
+    return problems
+
 
 # one row of the throughput-vs-devices scaling curve (`scaling` field):
 # `n_devices` is the dp x mp mesh extent the block phase ran under,
@@ -178,6 +224,8 @@ def validate_result(result) -> List[str]:
         problems.extend(validate_scaling(result["scaling"]))
     if isinstance(result.get("soak"), dict):
         problems.extend(validate_soak(result["soak"]))
+    if isinstance(result.get("state"), dict):
+        problems.extend(validate_state(result["state"]))
     return problems
 
 

@@ -16,6 +16,14 @@ Registered sites (grep for `faults.fire` to confirm the live set):
     remote.recv          client-side, before a response frame is read
     batch.verify         inside the device-plane block verify (degrades
                          to host validation, never fails the block)
+    vault.append         before a vault-journal record is written +
+                         fsync'd (a failure degrades LOUDLY — counter +
+                         flight event — the in-memory view still applies)
+    vault.snapshot       before a vault snapshot compaction (a failure
+                         only means the journal keeps growing)
+    vault.recover        at the start of `PersistentTokenStore.recover`
+    selector.lock        inside `ShardedLocker.try_lock` (kind `delay`
+                         widens contention windows for chaos runs)
 
 Arming:
 

@@ -179,6 +179,107 @@ def test_ftstop_scaling_gate(tmp_path, capsys):
     assert ftstop.main(["compare", "--history", path, "--scaling"]) == 2
 
 
+def _state(p99=0.002, pop=50000.0, rec=40000.0):
+    return {
+        "tokens": 1000000,
+        "populate_s": 20.0,
+        "populate_tokens_per_s": pop,
+        "recover_s": 25.0,
+        "recover_tokens_per_s": rec,
+        "selector_p99_s": p99,
+        "rss_high_water_mb": 900.0,
+        "selects": 400,
+        "spends": 1800,
+        "threads": 4,
+        "small_tokens": 10000,
+        "selector_p99_small_s": 0.001,
+        "sublinear_ratio": 2.0,
+    }
+
+
+def test_state_section_schema():
+    """The state-plane scale section is field-checked like soak/scaling:
+    a result carrying a valid section passes, malformed ones are named."""
+    r = _full()
+    r["state"] = _state()
+    assert benchschema.validate_result(r) == []
+    assert benchschema.validate_state(r["state"]) == []
+    assert benchschema.validate_state("nope")
+    assert benchschema.validate_state({})  # all required fields missing
+    broken = _state()
+    broken["selector_p99_s"] = "slow"
+    assert benchschema.validate_state(broken)
+    broken = _state()
+    broken["tokens"] = -5
+    assert any("negative" in p for p in benchschema.validate_state(broken))
+    # nullable calibration fields stay valid as null
+    ok = _state()
+    ok["sublinear_ratio"] = None
+    ok["selector_p99_small_s"] = None
+    assert benchschema.validate_state(ok) == []
+    # a result with a broken section fails result validation too
+    r["state"] = broken
+    assert benchschema.validate_result(r)
+
+
+def _history_with_states(tmp_path, states):
+    path = str(tmp_path / "BENCH_history.jsonl")
+    for s in states:
+        r = _full()
+        if s is not None:
+            r["state"] = s
+        bench.append_history(r, path=path)
+    return path
+
+
+def test_ftstop_state_gate(tmp_path, capsys):
+    """`ftstop compare --state` gates selector p99 (growth) and
+    populate/recover throughput (drop) against the median of prior
+    state-carrying rounds: rc 0 steady, rc 1 on regression, rc 2 when
+    fewer than two rounds carry the section."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "cmd"))
+    try:
+        import ftstop
+    finally:
+        sys.path.pop(0)
+
+    # steady numbers -> ok; state-less rounds are skipped
+    path = _history_with_states(
+        tmp_path, [_state(), None, _state(p99=0.0021)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--state"]) == 0
+    out = capsys.readouterr().out
+    assert "state plane" in out and "selector_p99" in out and "OK" in out
+
+    # p99 grows >10% -> regression rc 1 (direction-aware: growth is bad)
+    os.makedirs(tmp_path / "p", exist_ok=True)
+    path = _history_with_states(tmp_path / "p", [_state(), _state(p99=0.01)])
+    assert ftstop.main(["compare", "--history", path, "--state"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert ftstop.main(
+        ["compare", "--history", path, "--state", "--no-fail"]
+    ) == 0
+
+    # recover throughput drops >10% -> regression too
+    os.makedirs(tmp_path / "r", exist_ok=True)
+    path = _history_with_states(tmp_path / "r", [_state(), _state(rec=1000.0)])
+    assert ftstop.main(["compare", "--history", path, "--state"]) == 1
+
+    # improvements never fail the gate
+    os.makedirs(tmp_path / "i", exist_ok=True)
+    path = _history_with_states(
+        tmp_path / "i", [_state(), _state(p99=0.0001, pop=99999.0)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--state"]) == 0
+
+    # fewer than two state-carrying rounds -> rc 2
+    os.makedirs(tmp_path / "s", exist_ok=True)
+    path = _history_with_states(tmp_path / "s", [None, _state()])
+    assert ftstop.main(["compare", "--history", path, "--state"]) == 2
+
+
 def test_history_roundtrip_with_torn_tail(tmp_path):
     path = str(tmp_path / "BENCH_history.jsonl")
     assert bench.append_history(_full(), path=path) == path

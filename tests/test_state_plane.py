@@ -1,0 +1,774 @@
+"""State-plane suite: the pluggable vault stores (in-memory + crash-safe
+persistent), the (type, owner) quantity-ordered selection index, the
+sharded locker with deadline-aware backoff, the ttxdb integrity fixes —
+and the chaos acceptance: a client process SIGKILLed mid-spend-workload
+recovers its vault (`Vault.recover`) to exactly the acknowledged-finality
+replay, with a torn journal tail truncated and zero leaked selector
+locks, under `FTS_FAULTS` injection on the new `vault.*` sites.
+"""
+
+import os
+import select
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from fabric_token_sdk_tpu.drivers.fabtoken import (
+    FabTokenDriver,
+    FabTokenPublicParams,
+)
+from fabric_token_sdk_tpu.models.token import ID, Owner, Token
+from fabric_token_sdk_tpu.services.selector import (
+    InsufficientFunds,
+    SelectorManager,
+    SelectorTimeout,
+    ShardedLocker,
+)
+from fabric_token_sdk_tpu.services.vault import (
+    InMemoryTokenStore,
+    PersistentTokenStore,
+    Vault,
+    VaultDelta,
+)
+from fabric_token_sdk_tpu.services.vault.store import _Bucket, decoded_token
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+OWNER = b"state-test-owner"
+
+
+def _counter(name):
+    return mx.REGISTRY.counter(name).value
+
+
+def _driver():
+    return FabTokenDriver(FabTokenPublicParams())
+
+
+def synth(driver, tx, qty, index=0, owner=OWNER, token_type="USD"):
+    """One synthetic decoded StoredToken (fabtoken clear-text bytes)."""
+    tid = ID(tx, index)
+    out = Token(Owner(owner), token_type, hex(qty)).to_bytes()
+    return decoded_token(driver.output_to_unspent, tid, out, None)
+
+
+def mk_vault(store=None, driver=None):
+    drv = driver or _driver()
+    return Vault(drv, lambda ident: ident == OWNER, store=store), drv
+
+
+def fill(vault, drv, quantities, tx_prefix="t", token_type="USD"):
+    vault.store.apply(VaultDelta("fill", stores=[
+        synth(drv, f"{tx_prefix}{i}", q, token_type=token_type)
+        for i, q in enumerate(quantities)
+    ]))
+
+
+# ===================================================================
+# Store + index units
+# ===================================================================
+
+
+def test_bucket_orders_and_compacts():
+    b = _Bucket()
+    for i, q in enumerate([5, 50, 1, 30, 7, 42, 9, 3, 11, 2]):
+        b.add(f"k{i}", q)
+    assert len(b) == 10
+    assert [-nq for nq, _k in b.merged()] == sorted(
+        [5, 50, 1, 30, 7, 42, 9, 3, 11, 2], reverse=True
+    )
+    # merged() snapshots are immutable: later adds build a NEW list
+    snap = b.merged()
+    b.add("k10", 100)
+    assert b.merged() is not snap and b.merged()[0] == (-100, "k10")
+    # the dead PREFIX trims on every merged() — spent-largest-first is
+    # the dominant pattern, so selection never re-walks its own spends
+    # even while mid-list tombstones are below the rebuild threshold
+    b.discard("k10")  # the current front (qty 100)
+    b.discard("k1")   # next front (qty 50)
+    trimmed = b.merged()
+    assert trimmed[0] == (-42, "k5")  # dead prefix gone
+    assert b._stale == 0
+    # tombstones compact away once they outnumber the live entries
+    for i in range(9):
+        b.discard(f"k{i}")
+    assert len(b) == 1
+    assert b.merged() == [(-2, "k9")]
+
+
+def test_store_index_and_cert_drop():
+    drv = _driver()
+    store = InMemoryTokenStore()
+    store.apply(VaultDelta("a", stores=[
+        synth(drv, "a", 10), synth(drv, "b", 40),
+        synth(drv, "c", 25, token_type="EUR"),
+    ]))
+    store.apply(VaultDelta("", certs=[(ID("b", 0).key(), b"cert-b")]))
+    # candidates walk one type only, quantity-descending
+    assert [q for q, _k in store.candidates("USD")] == [40, 10]
+    assert [q for q, _k in store.candidates("EUR")] == [25]
+    assert list(store.candidates("JPY")) == []
+    assert store.certification(ID("b", 0).key()) == b"cert-b"
+    # spending b drops its token AND its certification (the leak fix)
+    before = _counter("vault.certs.dropped")  # counted by the vault layer
+    stats = store.apply(VaultDelta("spend", spends=[ID("b", 0).key()]))
+    assert stats == {"spent": 1, "stored": 0, "certs_dropped": 1}
+    assert store.certification(ID("b", 0).key()) is None
+    assert store.cert_count() == 0
+    assert _counter("vault.certs.dropped") == before  # vault layer counts it
+    # stale index entries filter against the live store
+    assert store.get(ID("b", 0).key()) is None
+    assert len(store) == 2
+
+
+def test_vault_api_preserved_and_cert_drop_counted():
+    from fabric_token_sdk_tpu.api.request import (
+        IssueRecord,
+        TokenRequest,
+        TransferRecord,
+    )
+    from fabric_token_sdk_tpu.services.network.ledger import (
+        FinalityEvent,
+        TxStatus,
+    )
+
+    vault, drv = mk_vault()
+    outcome = drv.issue(OWNER, "USD", [10, 5], [OWNER, OWNER])
+    req = TokenRequest(anchor="issue")
+    req.issues.append(IssueRecord(
+        action=outcome.action_bytes, issuer=OWNER,
+        outputs_metadata=outcome.metadata, receivers=[OWNER, OWNER],
+    ))
+    vault.on_finality(FinalityEvent("issue", TxStatus.VALID), req)
+    assert vault.balance("USD") == 15
+    # insertion order preserved (suites zip token_ids with issue values)
+    assert [i.key() for i in vault.token_ids()] == ["issue.0", "issue.1"]
+    outs, metas = vault.get_many([ID("issue", 0)])
+    assert outs[0] == outcome.outputs[0]
+    vault.store_certification(ID("issue", 0), b"c0")
+    assert vault.certification(ID("issue", 0)) == b"c0"
+
+    # spend issue.0 -> its certification is dropped and counted
+    before = _counter("vault.certs.dropped")
+    tout = drv.transfer([ID("issue", 0)], [outcome.outputs[0]],
+                        [outcome.metadata[0]], "USD", [10], [OWNER])
+    treq = TokenRequest(anchor="spend")
+    treq.transfers.append(TransferRecord(
+        action=tout.action_bytes, input_ids=[ID("issue", 0)],
+        senders=[OWNER], outputs_metadata=tout.metadata, receivers=[OWNER],
+    ))
+    vault.on_finality(FinalityEvent("spend", TxStatus.VALID), treq)
+    assert vault.balance("USD") == 15
+    assert vault.certification(ID("issue", 0)) is None
+    assert _counter("vault.certs.dropped") - before == 1
+    # an INVALID event changes nothing
+    vault.on_finality(FinalityEvent("spend2", TxStatus.INVALID), treq)
+    assert vault.balance("USD") == 15
+
+
+# ===================================================================
+# Persistent store: journal, snapshot, recovery
+# ===================================================================
+
+
+def test_persistent_vault_survives_restart(tmp_path):
+    path = str(tmp_path / "vault.wal")
+    drv = _driver()
+    store = PersistentTokenStore(path, snapshot_every=0)
+    vault, _ = mk_vault(store=store, driver=drv)
+    fill(vault, drv, [10, 20, 30])
+    vault.store_certification(ID("t2", 0), b"cert-30")
+    store.apply(VaultDelta("spend", spends=[ID("t0", 0).key()]))
+    live_ids = sorted(st.id.key() for st in store.tokens())
+    store.close()
+
+    v2 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert sorted(st.id.key() for st in v2.store.tokens()) == live_ids
+    assert v2.balance("USD") == 50
+    assert v2.certification(ID("t2", 0)) == b"cert-30"
+    assert v2.certification(ID("t0", 0)) is None
+    # the recovered vault keeps journaling to the same files
+    v2.store.apply(VaultDelta("more", stores=[synth(drv, "t9", 9)]))
+    v2.store.close()
+    v3 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert v3.balance("USD") == 59
+    v3.store.close()
+
+
+def test_vault_recover_truncates_torn_tail(tmp_path):
+    path = str(tmp_path / "vault.wal")
+    drv = _driver()
+    store = PersistentTokenStore(path, snapshot_every=0)
+    vault, _ = mk_vault(store=store, driver=drv)
+    fill(vault, drv, [7, 8])
+    store.close()
+    # crash mid-append of the NEXT record: torn header + partial payload
+    with open(path, "ab") as fh:
+        fh.write(struct.pack(">II", 1 << 20, 0) + b"torn")
+    torn_before = _counter("wal.torn_tails")
+    v2 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert _counter("wal.torn_tails") - torn_before == 1
+    assert v2.balance("USD") == 15  # the acknowledged prefix, exactly
+    # the truncated journal accepts fresh appends cleanly
+    v2.store.apply(VaultDelta("new", stores=[synth(drv, "n", 1)]))
+    v2.store.close()
+    v3 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert v3.balance("USD") == 16
+    v3.store.close()
+
+
+def test_vault_snapshot_compaction_and_idempotent_replay(tmp_path):
+    path = str(tmp_path / "vault.wal")
+    drv = _driver()
+    snaps_before = _counter("vault.snapshots")
+    store = PersistentTokenStore(path, snapshot_every=4)
+    vault, _ = mk_vault(store=store, driver=drv)
+    for i in range(6):
+        store.apply(VaultDelta(f"e{i}", stores=[synth(drv, f"t{i}", i + 1)]))
+    store.apply(VaultDelta("spend", spends=[ID("t0", 0).key()]))
+    assert _counter("vault.snapshots") - snaps_before >= 1
+    assert os.path.exists(path + ".snap")
+    live = sorted(st.id.key() for st in store.tokens())
+    balance = vault.balance("USD")
+
+    # normal recovery: snapshot + journal suffix
+    store.close()
+    v2 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert sorted(st.id.key() for st in v2.store.tokens()) == live
+    assert v2.balance("USD") == balance
+
+    # the crash-between-snapshot-and-truncate window: a snapshot that
+    # already covers the whole journal, with the journal NOT yet reset —
+    # replaying the full journal on top must be idempotent
+    with open(path + ".snap", "wb") as fh:
+        fh.write(v2.store._snapshot_bytes())
+    v2.store.close()  # journal untouched: still holds the suffix records
+    v3 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert sorted(st.id.key() for st in v3.store.tokens()) == live
+    assert v3.balance("USD") == balance
+    v3.store.close()
+
+
+def test_vault_append_failure_degrades_loudly(tmp_path):
+    """An armed `vault.append` fault: the journal append fails, the
+    counter + flight event fire, and the IN-MEMORY view still applies —
+    durability degrades, correctness of the running process does not."""
+    path = str(tmp_path / "vault.wal")
+    drv = _driver()
+    store = PersistentTokenStore(path, snapshot_every=0)
+    vault, _ = mk_vault(store=store, driver=drv)
+    fill(vault, drv, [10])
+    fails_before = _counter("vault.append_failures")
+    injected_before = _counter("faults.injected.vault.append")
+    faults.arm("vault.append", "error", count=1)
+    store.apply(VaultDelta("lost", stores=[synth(drv, "lost", 5)]))
+    assert _counter("vault.append_failures") - fails_before == 1
+    assert _counter("faults.injected.vault.append") - injected_before == 1
+    assert vault.balance("USD") == 15  # in-memory view intact
+    # later events journal again; recovery shows exactly the durable set
+    store.apply(VaultDelta("kept", stores=[synth(drv, "kept", 3)]))
+    store.close()
+    v2 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert v2.get(ID("lost", 0)) is None  # the degraded write is the gap
+    assert v2.get(ID("kept", 0)) is not None
+    assert v2.balance("USD") == 13
+    v2.store.close()
+
+
+def test_vault_snapshot_and_recover_fault_sites(tmp_path):
+    path = str(tmp_path / "vault.wal")
+    drv = _driver()
+    store = PersistentTokenStore(path, snapshot_every=2)
+    vault, _ = mk_vault(store=store, driver=drv)
+    # a failing compaction is isolated: counted, journal keeps growing
+    snap_fail_before = _counter("vault.snapshot_failures")
+    faults.arm("vault.snapshot", "error", count=1)
+    fill(vault, drv, [1])
+    store.apply(VaultDelta("x", stores=[synth(drv, "x", 2)]))  # boundary
+    assert _counter("vault.snapshot_failures") - snap_fail_before == 1
+    assert not os.path.exists(path + ".snap")
+    assert vault.balance("USD") == 3
+    store.close()
+    faults.clear()
+    # recovery site: an armed error surfaces loudly instead of returning
+    # a silently-partial vault
+    faults.arm("vault.recover", "error", count=1)
+    with pytest.raises(faults.FaultInjected):
+        Vault.recover(path, drv, lambda ident: ident == OWNER)
+    faults.clear()
+    v2 = Vault.recover(path, drv, lambda ident: ident == OWNER)
+    assert v2.balance("USD") == 3  # journal alone carries everything
+    v2.store.close()
+
+
+# ===================================================================
+# Selector: sharded locks, indexed walk, deadline, self-hold
+# ===================================================================
+
+
+def test_sharded_locker_basics():
+    lk = ShardedLocker(shards=4)
+    ids = [ID(f"s{i}", 0) for i in range(32)]
+    for i in ids:
+        assert lk.try_lock(i, "txA")
+    assert lk.locked_count() == 32
+    assert not lk.try_lock(ids[0], "txB")
+    assert lk.holder(ids[0]) == "txA"
+    assert lk.is_locked(ids[5])
+    lk.unlock(ids[5])
+    assert not lk.is_locked(ids[5])
+    assert lk.try_lock(ids[5], "txB")
+    # unlock_by_tx releases exactly one tx's locks across every shard
+    lk.unlock_by_tx("txA")
+    assert lk.locked_count() == 1  # txB's lone lock survives
+    assert lk.holder(ids[5]) == "txB"
+    lk.unlock_by_tx("txB")
+    assert lk.locked_count() == 0
+
+
+def test_selector_walks_candidates_not_vault():
+    """Sub-linearity pin (deterministic, no timing): the candidates
+    examined per select depend on the amount requested, NOT on how many
+    tokens the vault holds."""
+    scanned = []
+    for n_tokens in (100, 10_000):
+        vault, drv = mk_vault()
+        vault.store.apply(VaultDelta("fill", stores=[
+            synth(drv, f"t{i}", 10) for i in range(n_tokens)
+        ]))
+        mgr = SelectorManager(vault)
+        before = _counter("selector.scanned")
+        ids, total = mgr.new_selector("tx").select(30, "USD")
+        assert total >= 30 and len(ids) == 3
+        scanned.append(_counter("selector.scanned") - before)
+        mgr.unlock_by_tx("tx")
+    assert scanned[0] == scanned[1] == 3
+
+
+def test_selector_prefers_largest_and_type_isolation():
+    vault, drv = mk_vault()
+    fill(vault, drv, [5, 100, 7], tx_prefix="usd")
+    fill(vault, drv, [1000], tx_prefix="eur", token_type="EUR")
+    mgr = SelectorManager(vault)
+    ids, total = mgr.new_selector("tx").select(90, "USD")
+    assert [i.tx_id for i in ids] == ["usd1"] and total == 100
+    with pytest.raises(InsufficientFunds):
+        mgr.new_selector("tx2").select(2000, "EUR")
+
+
+def test_selector_self_hold_semantics_pinned():
+    """Regression pin for the documented re-entrant semantics: tokens a
+    tx already earmarked are skipped WITHOUT counting toward a later
+    select's total (they can never be spent twice by one tx), so the
+    later select asks only for funds beyond the earmarked ones — and
+    raises InsufficientFunds when the remainder cannot cover it."""
+    vault, drv = mk_vault()
+    fill(vault, drv, [100, 10, 10])
+    mgr = SelectorManager(vault)
+    ids, total = mgr.new_selector("T").select(100, "USD")
+    assert total == 100 and len(ids) == 1
+    # second select, same tx: the 100-token is self-held -> not counted,
+    # not retryable; the two 10s cover a 15
+    held_before = _counter("selector.self_held")
+    ids2, total2 = mgr.new_selector("T").select(15, "USD")
+    assert total2 == 20 and {i.tx_id for i in ids2} == {"t1", "t2"}
+    assert _counter("selector.self_held") - held_before >= 1
+    # a third select cannot be satisfied by the remainder — typed error,
+    # NO retry loop (self-held tokens are not contention)
+    retry_before = _counter("selector.retry")
+    with pytest.raises(InsufficientFunds):
+        mgr.new_selector("T").select(5, "USD")
+    assert _counter("selector.retry") == retry_before
+    mgr.unlock_by_tx("T")
+    assert mgr.locker.locked_count() == 0
+
+
+def test_selector_deadline_budget():
+    """deadline_s switches selection to a WALL-CLOCK budget: however
+    many retries fit, the caller gets its typed SelectorTimeout when the
+    budget is spent — not after an arbitrary retry count."""
+    vault, drv = mk_vault()
+    fill(vault, drv, [10])
+    mgr = SelectorManager(vault)
+    assert mgr.new_selector("holder").select(10, "USD")[1] == 10
+    t0 = time.monotonic()
+    timeouts_before = _counter("selector.timeout")
+    with pytest.raises(SelectorTimeout):
+        mgr.new_selector(
+            "waiter", retries=10**9, backoff_s=0.01, deadline_s=0.25
+        ).select(10, "USD")
+    elapsed = time.monotonic() - t0
+    assert 0.25 <= elapsed < 5.0
+    assert _counter("selector.timeout") - timeouts_before == 1
+    # legacy retry-count path still works unchanged
+    with pytest.raises(SelectorTimeout):
+        mgr.new_selector("w2", retries=2, backoff_s=0.001).select(10, "USD")
+    mgr.unlock_by_tx("holder")
+
+
+def test_selector_stress_no_double_select():
+    """Satellite acceptance: K spender threads race over one shared
+    token type; no token is ever granted to two txs at once, contention
+    counters move, and `unlock_by_tx` releases everything on abort."""
+    vault, drv = mk_vault()
+    fill(vault, drv, [1] * 60)
+    mgr = SelectorManager(vault)
+    busy_before = _counter("selector.lock.busy")
+    retry_before = _counter("selector.retry")
+    in_use = set()
+    guard = threading.Lock()
+    errors = []
+    K, iterations, amount = 6, 8, 15  # 6*15 > 60: guaranteed contention
+
+    def spender(widx):
+        try:
+            for k in range(iterations):
+                tx = f"s{widx}-{k}"
+                sel = mgr.new_selector(tx, deadline_s=20.0, backoff_s=0.002)
+                ids, total = sel.select(amount, "USD")
+                assert total >= amount
+                keys = {i.key() for i in ids}
+                with guard:
+                    clash = in_use & keys
+                    assert not clash, f"double-selected {clash}"
+                    in_use.update(keys)
+                time.sleep(0.001)
+                with guard:
+                    in_use.difference_update(keys)
+                # every path releases via unlock_by_tx (the abort path)
+                mgr.unlock_by_tx(tx)
+                for i in ids:
+                    assert mgr.locker.holder(i) is None
+        except Exception as e:  # surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=spender, args=(w,)) for w in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+    assert not errors, errors[0]
+    assert mgr.locker.locked_count() == 0  # nothing leaked
+    assert _counter("selector.lock.busy") > busy_before
+    assert _counter("selector.retry") > retry_before
+    assert vault.balance("USD") == 60  # selection never mutates the vault
+
+
+def test_selector_lock_fault_site():
+    vault, drv = mk_vault()
+    fill(vault, drv, [5])
+    mgr = SelectorManager(vault)
+    injected_before = _counter("faults.injected.selector.lock")
+    faults.arm("selector.lock", "delay", delay_s=0.01, count=2)
+    ids, total = mgr.new_selector("tx").select(5, "USD")
+    assert total == 5
+    assert _counter("faults.injected.selector.lock") - injected_before >= 1
+    mgr.unlock_by_tx("tx")
+
+
+# ===================================================================
+# ttxdb integrity + scale fixes
+# ===================================================================
+
+
+def test_ttxdb_pk_upsert_index_wal(tmp_path):
+    from fabric_token_sdk_tpu.services.ttxdb.db import (
+        MovementDirection,
+        TransactionDB,
+        TxType,
+    )
+
+    db = TransactionDB(str(tmp_path / "ttx.db"))
+    # crash-consistent concurrent reads on file DBs
+    assert db._conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+    db.add_transaction("tx1", TxType.TRANSFER, "alice", "bob", "USD", 7)
+    db.set_status("tx1", "Confirmed")
+    # a resubmission UPSERTS (one row, fresh status) instead of
+    # inserting a duplicate that status() would silently shadow
+    db.add_transaction("tx1", TxType.TRANSFER, "alice", "bob", "USD", 7)
+    assert len(db.transactions()) == 1
+    assert db.status("tx1") == "Pending"
+    db.set_status("tx1", "Confirmed")
+    assert db.status("tx1") == "Confirmed"
+    # the movements query path is indexed (wallet_eid, direction, status)
+    assert db._conn.execute(
+        "SELECT name FROM sqlite_master WHERE type='index' "
+        "AND name='mov_wallet_idx'"
+    ).fetchone()
+    plan = db._conn.execute(
+        "EXPLAIN QUERY PLAN SELECT amount FROM movements WHERE "
+        "wallet_eid=? AND direction=? AND status='Confirmed'",
+        ("alice", "Sent"),
+    ).fetchall()
+    assert any("mov_wallet_idx" in str(row) for row in plan)
+    db.add_movement("tx1", "alice", "USD", 7, MovementDirection.SENT,
+                    "Confirmed")
+    db.add_movement("tx1", "bob", "USD", 7, MovementDirection.RECEIVED,
+                    "Confirmed")
+    assert db.payments("alice", "USD") == 7
+    assert db.holdings("bob", "USD") == 7
+    # in-memory DBs still construct (WAL pragma is a no-op there)
+    TransactionDB().add_transaction(
+        "m", TxType.ISSUE, "i", "", "USD", 1
+    )
+
+
+def test_ttxdb_migrates_legacy_schema(tmp_path):
+    """A DB file created BEFORE tx_id became the PRIMARY KEY (plain
+    table + tx_idx index, possibly holding duplicate rows) must reopen
+    cleanly: the table is rebuilt with the PK keeping the FIRST row per
+    tx_id (the old status() read order), and upserts work from then on."""
+    import sqlite3
+
+    from fabric_token_sdk_tpu.services.ttxdb.db import TransactionDB, TxType
+
+    path = str(tmp_path / "legacy.db")
+    conn = sqlite3.connect(path)
+    conn.executescript(
+        """
+        CREATE TABLE transactions (
+            tx_id TEXT, tx_type TEXT, sender_eid TEXT,
+            recipient_eid TEXT, token_type TEXT, amount TEXT,
+            status TEXT, timestamp REAL
+        );
+        CREATE TABLE movements (
+            tx_id TEXT, wallet_eid TEXT, token_type TEXT,
+            amount TEXT, direction TEXT, status TEXT
+        );
+        CREATE INDEX tx_idx ON transactions(tx_id);
+        INSERT INTO transactions VALUES
+            ('dup', 'Transfer', 'a', 'b', 'USD', '5', 'Confirmed', 1.0),
+            ('dup', 'Transfer', 'a', 'b', 'USD', '5', 'Pending', 2.0),
+            ('solo', 'Issue', 'i', '', 'USD', '9', 'Confirmed', 3.0);
+        """
+    )
+    conn.commit()
+    conn.close()
+    db = TransactionDB(path)
+    # the duplicate collapsed to the FIRST row (old read semantics)
+    assert db.status("dup") == "Confirmed"
+    assert db.status("solo") == "Confirmed"
+    assert len(db.transactions()) == 2
+    # and the upsert path now works on the migrated file
+    db.add_transaction("dup", TxType.TRANSFER, "a", "b", "USD", 5)
+    assert db.status("dup") == "Pending"
+    assert len(db.transactions()) == 2
+
+
+def test_party_persistent_vault_end_to_end(tmp_path):
+    """Product-path integration: a Party built with `vault_path=` runs a
+    real issue+transfer flow over the network, is torn down, and a
+    REBUILT party on the same path recovers its owned tokens — the
+    client restart no longer loses every owned token."""
+    from fabric_token_sdk_tpu.api.validator import RequestValidator
+    from fabric_token_sdk_tpu.api.wallet import AuditorWallet
+    from fabric_token_sdk_tpu.crypto import sign
+    from fabric_token_sdk_tpu.services.auditor import AuditorService
+    from fabric_token_sdk_tpu.services.network import Network
+    from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+
+    def mk():
+        return FabTokenDriver(FabTokenPublicParams())
+
+    aw = AuditorWallet("auditor", sign.keygen())
+    auditor_svc = AuditorService(mk(), aw)
+    network = Network(RequestValidator(mk(), aw.identity))
+    network.subscribe(auditor_svc.on_finality)
+    vault_path = str(tmp_path / "alice-vault.wal")
+    issuer_p = Party("issuer-node", mk(), network, auditor_identity=aw.identity)
+    alice_p = Party("alice-node", mk(), network, auditor_identity=aw.identity,
+                    vault_path=vault_path)
+    issuer = issuer_p.new_issuer_wallet("issuer")
+    alice = alice_p.new_owner_wallet("alice", anonymous=False)
+
+    tx = Transaction(issuer_p, "tx-issue")
+    tx.issue("issuer", "USD", [10, 5],
+             [alice.recipient_identity(), alice.recipient_identity()],
+             anonymous=False)
+    tx.collect_endorsements(auditor_svc)
+    tx.submit()
+    assert alice_p.balance("USD") == 15
+    alice_p.vault.store.close()
+
+    # "restart": a new party over the same journal path; the wallet key
+    # material is re-registered (identity layer), the TOKENS come back
+    # from the vault journal
+    alice2 = Party("alice-node", mk(), network, auditor_identity=aw.identity,
+                   vault_path=vault_path)
+    assert alice2.balance("USD") == 15
+    assert sorted(i.key() for i in alice2.vault.token_ids()) == [
+        "tx-issue.0", "tx-issue.1"
+    ]
+    alice2.vault.store.close()
+
+
+# ===================================================================
+# Bench state_scale phase (reduced config) + schema
+# ===================================================================
+
+
+def test_state_scale_phase_reduced(monkeypatch):
+    """End-to-end run of the bench `state_scale` phase at a reduced size:
+    populate -> compact -> recover -> concurrent select+spend, emitting a
+    section that validates against the shared bench schema — and proving
+    the sub-linearity witness is recorded."""
+    import bench
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    for key, val in (("FTS_BENCH_STATE_TOKENS", "3000"),
+                     ("FTS_BENCH_STATE_SMALL", "600"),
+                     ("FTS_BENCH_STATE_THREADS", "2"),
+                     ("FTS_BENCH_STATE_SELECTS", "30"),
+                     ("FTS_BENCH_STATE_BATCH", "1000"),
+                     ("FTS_BENCH_STATE_S", "20")):
+        monkeypatch.setenv(key, val)
+    hb = types.SimpleNamespace(set_phase=lambda *a, **k: None)
+    state = bench._state_scale(hb)
+    assert benchschema.validate_state(state) == []
+    assert state["tokens"] == 3000
+    assert state["selects"] > 0 and state["spends"] > 0
+    assert state["recover_tokens_per_s"] > 0
+    assert state["rss_high_water_mb"] > 0
+    assert state["sublinear_ratio"] is not None
+
+
+# ===================================================================
+# Chaos acceptance: SIGKILL a client mid-spend-workload
+# ===================================================================
+
+_CLIENT_CHILD = """
+import os, sys
+sys.path.insert(0, sys.argv[2])
+from fabric_token_sdk_tpu.api.request import IssueRecord, TokenRequest, TransferRecord
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.models.token import ID
+from fabric_token_sdk_tpu.services.network.ledger import FinalityEvent, TxStatus
+from fabric_token_sdk_tpu.services.vault import PersistentTokenStore, Vault
+
+path = sys.argv[1]
+me = b"chaos-owner"
+drv = FabTokenDriver(FabTokenPublicParams())
+store = PersistentTokenStore(path, snapshot_every=8)
+vault = Vault(drv, lambda ident: ident == me, store=store)
+
+outcome = drv.issue(me, "USD", [5] * 8, [me] * 8)
+req = TokenRequest(anchor="seed")
+req.issues.append(IssueRecord(action=outcome.action_bytes, issuer=me,
+                              outputs_metadata=outcome.metadata,
+                              receivers=[me] * 8))
+vault.on_finality(FinalityEvent("seed", TxStatus.VALID), req)
+vault.store_certification(ID("seed", 0), b"cert-seed-0")
+print("ACK seed", flush=True)
+
+prev, prev_raw, prev_meta = ID("seed", 0), outcome.outputs[0], outcome.metadata[0]
+k = 0
+while True:
+    tx = f"spend-{k}"
+    tout = drv.transfer([prev], [prev_raw], [prev_meta], "USD", [5], [me])
+    treq = TokenRequest(anchor=tx)
+    treq.transfers.append(TransferRecord(
+        action=tout.action_bytes, input_ids=[prev], senders=[me],
+        outputs_metadata=tout.metadata, receivers=[me]))
+    vault.on_finality(FinalityEvent(tx, TxStatus.VALID), treq)
+    print(f"ACK {tx}", flush=True)
+    prev, prev_raw, prev_meta = ID(tx, 0), tout.outputs[0], tout.metadata[0]
+    k += 1
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_client_vault_recovers(tmp_path):
+    """Acceptance: a client process SIGKILLed mid-spend-workload (with
+    `FTS_FAULTS` delay injection armed on `vault.append` to widen the
+    kill window) recovers via `Vault.recover` with balances exactly
+    equal to the acknowledged-finality replay — every acknowledged spend
+    is applied (no double-spendable phantom of a spent token), the
+    spent token's certification is gone, an artificially torn journal
+    tail is truncated cleanly, and a fresh selector can lock every
+    recovered token (zero leaked locks)."""
+    path = str(tmp_path / "client-vault.wal")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FTS_FAULTS="vault.append:delay:1.0:1000000:0.005")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CLIENT_CHILD, path, REPO_ROOT],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    acked = []
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline and len(acked) < 10:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"chaos child died rc={proc.returncode}:\n"
+                    f"{proc.stderr.read()}"
+                )
+            ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+            if ready:
+                line = proc.stdout.readline()
+                assert line.startswith("ACK"), line
+                acked.append(line.split()[1])
+        assert len(acked) >= 10, f"child too slow, acked only {acked}"
+        os.kill(proc.pid, signal.SIGKILL)  # mid-workload, no warning
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # crash-simulate a torn final record on top of whatever the kill
+    # left (the journal may legitimately be freshly compacted-empty —
+    # snapshot_every=8 fired mid-workload — but the snapshot must exist)
+    assert os.path.getsize(path) > 0 or os.path.exists(path + ".snap")
+    with open(path, "ab") as fh:
+        fh.write(struct.pack(">II", 1 << 20, 0) + b"torn")
+
+    drv = _driver()
+    torn_before = _counter("wal.torn_tails")
+    vault = Vault.recover(path, drv, lambda ident: ident == b"chaos-owner")
+    assert _counter("wal.torn_tails") - torn_before == 1
+
+    # conservation: every event preserves 8 tokens x 5 USD
+    assert vault.balance("USD") == 40
+    held = {st.id.key() for st in vault.store.tokens()}
+    # the recovered state is the replay of a PREFIX at least as long as
+    # the acknowledged one: seed.1..seed.7 plus exactly one chain head
+    # spend-M.0 with M >= the last acknowledged spend (the kill can land
+    # after a journal append but before its ACK printed)
+    spends_acked = [a for a in acked if a.startswith("spend-")]
+    last_acked = max(int(a.split("-")[1]) for a in spends_acked)
+    base = {f"seed.{i}" for i in range(1, 8)}
+    assert base <= held
+    heads = held - base
+    assert len(heads) == 1, f"unexpected recovered set: {held}"
+    head = heads.pop()
+    assert head.startswith("spend-")
+    m = int(head.split("-")[1].split(".")[0])
+    assert m >= last_acked
+    # no double-spendable phantoms: every acknowledged-spent token is gone
+    assert "seed.0" not in held
+    for k in range(m):
+        assert f"spend-{k}.0" not in held
+    # the spent seed token's certification died with it
+    assert vault.certification(ID("seed", 0)) is None
+
+    # zero leaked selector locks: a fresh selector can lock EVERY token
+    mgr = SelectorManager(vault)
+    ids, total = mgr.new_selector("post-recovery").select(40, "USD")
+    assert total == 40 and len(ids) == 8
+    mgr.unlock_by_tx("post-recovery")
+    assert mgr.locker.locked_count() == 0
+    # and the recovered vault accepts + journals fresh work
+    vault.store.apply(VaultDelta("fresh", stores=[synth(drv, "fresh", 2,
+                                                        owner=b"chaos-owner")]))
+    vault.store.close()
+    v2 = Vault.recover(path, drv, lambda ident: ident == b"chaos-owner")
+    assert v2.balance("USD") == 42
+    v2.store.close()
