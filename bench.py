@@ -599,21 +599,30 @@ def _block_throughput(pp, rng, hb, platform: str = "cpu",
     return result
 
 
-def _soak(hb) -> dict:
+def _soak(hb, zk_pp=None) -> dict:
     """Sustained-load soak: N client threads drive `submit_many` of
-    chained fabtoken transfers against ONE pipelined, WAL-journaled,
+    chained transfers against ONE pipelined, WAL-journaled,
     admission-controlled node for a fixed wall budget. The measured
     region is the whole streaming engine under concurrent pressure —
     bounded ordering queue (`FTS_BENCH_SOAK_QUEUE_MAX` ->
     `BlockPolicy.queue_max`), typed `Backpressure` shed cooperatively by
-    the batch submitters, pipelined verify/commit overlap, fsync'd WAL
-    per block — reporting steady-state tx/s, CLIENT-observed p99
-    finality (each tx's latency is its group's submit_many wall time),
-    queue-depth stability, and backpressure rejects. The per-client
-    corpus is a self-transfer CHAIN (tx k spends tx k-1's output), so
-    sustained load needs O(1) setup and every block exercises MVCC.
-    Sized by FTS_BENCH_SOAK_S / _CLIENTS / _GROUP; budget-aware like the
-    scaling sweep (never outlives the armed watchdog window)."""
+    the batch submitters, pipelined verify/commit overlap, the batched
+    signature plane (policy via `FTS_SIGN_BATCHED`; `sign_plane` in the
+    section records how it resolved), fsync'd WAL per block — reporting
+    steady-state tx/s, CLIENT-observed p99 finality (each tx's latency
+    is its group's submit_many wall time), queue-depth stability,
+    backpressure rejects, the `host_validate_s` fraction of block commit
+    wall time, and the `batch.sign.*` / `identity.cache.*` deltas. The
+    per-client corpus is a self-transfer CHAIN (tx k spends tx k-1's
+    output), so sustained load needs O(1) setup and every block
+    exercises MVCC. `FTS_BENCH_SOAK_DRIVER=zkatdlog` swaps the corpus to
+    1-in/1-out zkatdlog transfers (host-proved; verify/commit overlap
+    plus batched signatures on zk blocks — `zk_pp` injects prebuilt
+    params for tests, else a small `setup()` runs outside the measured
+    region). Sized by FTS_BENCH_SOAK_S / _CLIENTS / _GROUP;
+    budget-aware like the scaling sweep (never outlives the armed
+    watchdog window)."""
+    import dataclasses
     import tempfile
 
     from fabric_token_sdk_tpu.api.request import (
@@ -638,6 +647,11 @@ def _soak(hb) -> dict:
     group = max(1, int(os.environ.get("FTS_BENCH_SOAK_GROUP", "8")))
     duration = float(os.environ.get("FTS_BENCH_SOAK_S", "12"))
     qmax = int(os.environ.get("FTS_BENCH_SOAK_QUEUE_MAX", "64"))
+    driver_name = os.environ.get("FTS_BENCH_SOAK_DRIVER", "fabtoken")
+    if driver_name not in ("fabtoken", "zkatdlog"):
+        raise ValueError(
+            f"FTS_BENCH_SOAK_DRIVER={driver_name!r} (want fabtoken|zkatdlog)"
+        )
     remaining = _remaining_budget_s()
     if remaining is not None:
         if remaining < 20:
@@ -648,18 +662,45 @@ def _soak(hb) -> dict:
             )
             return {}
         duration = min(duration, remaining * 0.5)
-    hb.set_phase("soak", clients=clients, group=group,
+    hb.set_phase("soak", clients=clients, group=group, driver=driver_name,
                  duration_s=round(duration, 1))
     wal_path = os.path.join(
         tempfile.mkdtemp(prefix="fts-soak-wal-"), "ledger.wal"
     )
-    pp = FabTokenPublicParams()
+    if driver_name == "zkatdlog":
+        from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+
+        if zk_pp is None:
+            from fabric_token_sdk_tpu.crypto.setup import setup
+
+            zk_pp = setup(base=4, exponent=2, rng=random.Random(0xF75))
+        def make_driver():
+            return ZKATDLogDriver(zk_pp)
+    else:
+        fab_pp = FabTokenPublicParams()
+
+        def make_driver():
+            return FabTokenDriver(fab_pp)
+    # policy rides the ambient FTS_BLOCK_* / FTS_SIGN_* env (so a zk soak
+    # can e.g. disable the proof plane on an emulated host) with the
+    # soak's own block size + admission bound imposed on top
+    policy = dataclasses.replace(
+        BlockPolicy.from_env(), max_block_txs=4 * group, queue_max=qmax
+    )
     net = Network(
-        RequestValidator(FabTokenDriver(pp)),
-        policy=BlockPolicy(max_block_txs=4 * group, queue_max=qmax),
+        RequestValidator(make_driver()),
+        policy=policy,
         wal_path=wal_path,
     )
     rejects_before = mx.REGISTRY.counter("orderer.backpressure.rejects").value
+    sign_before = {
+        name: mx.REGISTRY.counter(name).value
+        for name in ("batch.sign.rows", "batch.sign.host",
+                     "batch.sign.host_fallbacks",
+                     "identity.cache.hits", "identity.cache.misses")
+    }
+    hv_before = mx.REGISTRY.histogram("ledger.block.host_validate.seconds").sum
+    commit_before = mx.REGISTRY.histogram("ledger.block.commit.seconds").sum
 
     stop = threading.Event()
     depth_peak = [0.0]
@@ -677,12 +718,12 @@ def _soak(hb) -> dict:
 
     def client(idx):
         rng = random.Random(0xF75 + idx)
-        drv = FabTokenDriver(pp)
+        drv = make_driver()
         key = sign.keygen(rng)
         ident = identity.pk_identity(key.public)
         try:
             anchor = f"soak-{idx}-seed"
-            outcome = drv.issue(ident, "USD", [7], [ident])
+            outcome = drv.issue(ident, "USD", [7], [ident], anonymous=False)
             req = TokenRequest(anchor=anchor)
             req.issues.append(
                 IssueRecord(action=outcome.action_bytes, issuer=ident,
@@ -692,14 +733,15 @@ def _soak(hb) -> dict:
             req.issues[0].signature = key.sign(req.marshal_to_sign(), rng)
             ev = net.submit(req.to_bytes())
             assert ev.status.value == "Valid", f"soak seed: {ev.message}"
-            prev, prev_raw = ID(anchor, 0), outcome.outputs[0]
+            prev = ID(anchor, 0)
+            prev_raw, prev_meta = outcome.outputs[0], outcome.metadata[0]
             k = 0
             while not stop.is_set():
                 batch = []
                 for j in range(group):
                     tx_id = f"soak-{idx}-{k}-{j}"
                     tout = drv.transfer(
-                        [prev], [prev_raw], [prev_raw], "USD", [7], [ident]
+                        [prev], [prev_raw], [prev_meta], "USD", [7], [ident]
                     )
                     treq = TokenRequest(anchor=tx_id)
                     treq.transfers.append(
@@ -714,7 +756,8 @@ def _soak(hb) -> dict:
                         key.sign(treq.marshal_to_sign(), rng)
                     ]
                     batch.append(treq.to_bytes())
-                    prev, prev_raw = ID(tx_id, 0), tout.outputs[0]
+                    prev = ID(tx_id, 0)
+                    prev_raw, prev_meta = tout.outputs[0], tout.metadata[0]
                 t0 = time.monotonic()
                 events = net.submit_many(batch)
                 dt = time.monotonic() - t0
@@ -754,6 +797,21 @@ def _soak(hb) -> dict:
         mx.REGISTRY.counter("orderer.backpressure.rejects").value
         - rejects_before
     )
+    sign_delta = {
+        name: int(mx.REGISTRY.counter(name).value - before)
+        for name, before in sign_before.items()
+    }
+    cache_lookups = (
+        sign_delta["identity.cache.hits"] + sign_delta["identity.cache.misses"]
+    )
+    hv_s = (
+        mx.REGISTRY.histogram("ledger.block.host_validate.seconds").sum
+        - hv_before
+    )
+    commit_s = (
+        mx.REGISTRY.histogram("ledger.block.commit.seconds").sum
+        - commit_before
+    )
     soak = {
         "steady_txs_per_s": round(rate, 2),
         "p99_finality_s": round(p99, 4) if p99 is not None else None,
@@ -762,12 +820,35 @@ def _soak(hb) -> dict:
         "clients": clients,
         "duration_s": round(elapsed, 1),
         "txs": committed[0],
+        # the batched-signature-plane accounting of this soak: what
+        # ACTUALLY happened ("device" = rows rode the device plane,
+        # "degraded" = plane enabled but every row fell to host,
+        # "host" = plane off), the host_validate leg's share of block
+        # commit wall time, and the sign/identity-cache deltas
+        "driver": driver_name,
+        "sign_plane": (
+            "device" if sign_delta["batch.sign.rows"] > 0
+            else "degraded" if net._pipeline.sign_enabled()
+            else "host"
+        ),
+        "host_validate_frac": (
+            round(hv_s / commit_s, 4) if commit_s > 0 else None
+        ),
+        "sign_rows": sign_delta["batch.sign.rows"],
+        "sign_host": sign_delta["batch.sign.host"],
+        "sign_fallbacks": sign_delta["batch.sign.host_fallbacks"],
+        "identity_cache_hit_rate": (
+            round(sign_delta["identity.cache.hits"] / cache_lookups, 4)
+            if cache_lookups else None
+        ),
     }
     mx.gauge("bench.soak_txs_per_s").set(soak["steady_txs_per_s"])
     if p99 is not None:
         mx.gauge("bench.soak_p99_finality_s").set(soak["p99_finality_s"])
     mx.gauge("bench.soak_queue_depth_max").set(soak["queue_depth_max"])
     mx.gauge("bench.soak_backpressure_rejects").set(soak["backpressure_rejects"])
+    if soak["host_validate_frac"] is not None:
+        mx.gauge("bench.soak_host_validate_frac").set(soak["host_validate_frac"])
     return soak
 
 

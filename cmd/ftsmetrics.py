@@ -143,6 +143,25 @@ def show(path: str, prometheus: bool = False) -> None:
             f" device_frac={frac:.2f}"
         )
 
+    # one-line sign-plane health: how much signature verification rode
+    # the batched device plane vs the host loop (fallbacks nonzero means
+    # the degrade-only contract fired), plus the identity parse-cache
+    # hit rate shared by both paths
+    s_batches = ctr.get("batch.sign.batches", 0)
+    s_rows = ctr.get("batch.sign.rows", 0)
+    s_host = ctr.get("batch.sign.host", 0)
+    s_fall = ctr.get("batch.sign.host_fallbacks", 0)
+    ic_hits = ctr.get("identity.cache.hits", 0)
+    ic_miss = ctr.get("identity.cache.misses", 0)
+    if s_batches or s_host or s_fall or ic_hits or ic_miss:
+        lookups = ic_hits + ic_miss
+        hit_rate = ic_hits / lookups if lookups else 0.0
+        print(
+            f"sign summary: batches={s_batches} device_rows={s_rows}"
+            f" host={s_host} host_fallbacks={s_fall}"
+            f" identity_cache_hit_rate={hit_rate:.2f}"
+        )
+
     # one-line tracing health: how many distributed traces / trace-tagged
     # spans this run produced, flight-recorder traffic, and ring dumps
     # (assemble the actual timelines with cmd/ftstrace.py)

@@ -124,6 +124,7 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
             f"last_block=#{lb.get('number')}[{lb.get('txs')}tx "
             f"{_s(lb.get('commit_s'))}"
             f" dev={_s(bd.get('device_verify_s'))}"
+            f" sign={_s(bd.get('sign_verify_s'))}"
             f" wal={_s(bd.get('wal_s'))}]"
         )
     return "  ".join(parts)
@@ -385,7 +386,10 @@ def compare_soak(args) -> int:
             f"soak, latest round: steady={s['steady_txs_per_s']:g}tx/s "
             f"p99_finality={s.get('p99_finality_s')} "
             f"queue_max={s['queue_depth_max']:g} "
-            f"backpressure={s['backpressure_rejects']}"
+            f"backpressure={s['backpressure_rejects']} "
+            f"driver={s.get('driver', 'fabtoken')} "
+            f"sign={s.get('sign_plane', '-')} "
+            f"host_validate_frac={s.get('host_validate_frac', '-')}"
         ),
     )
 

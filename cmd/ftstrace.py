@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Tuple
 # breakdown keys of a `block.commit` flight event, in pipeline order
 # (`overlap_s` only present on blocks the pipelined engine committed)
 BLOCK_BREAKDOWN_KEYS = (
-    "queue_wait_max_s", "grouping_s", "device_verify_s",
+    "queue_wait_max_s", "grouping_s", "device_verify_s", "sign_verify_s",
     "host_validate_s", "wal_s", "merge_s", "overlap_s",
 )
 

@@ -104,6 +104,7 @@ class Driver(abc.ABC):
                           signatures: Sequence[bytes],
                           now: Optional[float] = None,
                           proof_verified: Optional[bool] = None,
+                          sig_verified: Optional[Dict[int, tuple]] = None,
                           ) -> Tuple[List[ID], List[bytes]]:
         """Validate a transfer action; returns (spent ids, outputs to write).
         `now` is the deterministic commit timestamp (script deadlines etc.
@@ -111,7 +112,22 @@ class Driver(abc.ABC):
         block-batched plane's verdict on the action's ZK proof — True:
         skip the host proof check, False: reject, None: verify on host.
         Drivers without ZK proofs ignore it (their `transfer_batch_plan`
-        never emits a plan, so it is always None for them)."""
+        never emits a plan, so it is always None for them).
+
+        `sig_verified` carries the batched SIGNATURE plane's verdicts:
+        `{signature_index: (identity_bytes, bool)}`. A verdict applies
+        ONLY when `identity_bytes` equals the owner identity the host
+        check would verify against (defense in depth — the verdict was
+        computed over the ACTION-claimed owner, which the inputs==ledger
+        pin makes equal); True skips the host signature check, False
+        rejects, a missing/mismatched entry host-verifies. The validator
+        passes the kwarg only when it HAS verdicts, and verdicts only
+        exist for drivers whose own `transfer_sign_plan` emitted owners
+        — so accepting `sig_verified` is part of the same SPI opt-in
+        (drivers without the sign-plan hooks are never called with it,
+        and a `vguard`-decorated validate_transfer would convert a
+        binding TypeError into a spurious rejection, so implement both
+        or neither)."""
 
     # ------------------------------------------------------------ batching
 
@@ -134,6 +150,26 @@ class Driver(abc.ABC):
         """The driver's batched transfer-proof GENERATOR (the prove-side
         twin of `batch_verifier`), or None when the driver proves on the
         host only (default). `mesh` as in `batch_verifier`."""
+        return None
+
+    def transfer_sign_plan(self, action_bytes: bytes):
+        """Optional hook for the block-batched SIGNATURE plane: the
+        owner identity blobs a transfer action's signatures must verify
+        against, one per required signature, in signature order — the
+        ACTION-claimed owners (`validate_transfer` separately pins them
+        to ledger state, so a verdict computed over them is exactly the
+        host check). Return None (default) to route every signature of
+        this action through the host path (malformed bytes, drivers
+        whose owners are not identity blobs)."""
+        return None
+
+    def issue_sign_plan(self, action_bytes: bytes):
+        """Signature-plane hook for issue actions: the issuer identity
+        whose signature the request must carry (the same identity
+        `validate_issue` returns after its authorization checks — the
+        two MUST agree or the verdict is discarded by the identity
+        match), or None when the issue needs no signature (anonymous
+        issuance) or the action cannot be planned (default)."""
         return None
 
     def transfer_many(self, transfers: Sequence[tuple], rng=None,

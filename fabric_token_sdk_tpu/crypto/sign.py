@@ -30,11 +30,13 @@ class PublicKey:
     def verify(self, message: bytes, sig_raw: bytes) -> None:
         d = loads(sig_raw)
         chal, resp = d["c"], d["z"]
-        # com = g^z / pk^c ; challenge must rebind
-        com = hm.g1_add(
-            hm.g1_mul(hm.G1_GEN, resp), hm.g1_neg(hm.g1_mul(self.point, chal))
-        )
-        if _challenge(self.point, com, message) != chal:
+        # com = g^z · pk^{-c}; the negation rides the SCALAR, so this is
+        # verbatim the response equation the batched plane's sub tile
+        # evaluates (`crypto/batch_sign.py`: msm(g, z) - mul(pk, c)) —
+        # one equation, two executors, differential-pinned in
+        # tests/test_batch_sign.py
+        com = response_commitment(self.point, chal, resp)
+        if challenge(self.point, com, message) != chal:
             raise ValueError("invalid signature")
 
 
@@ -55,5 +57,18 @@ def keygen(rng=None) -> SigningKey:
     return SigningKey(sk, PublicKey(hm.g1_mul(hm.G1_GEN, sk)))
 
 
-def _challenge(pk_point, com, message: bytes) -> int:
+def response_commitment(pk_point, chal: int, resp: int):
+    """The shared response equation: com = g^resp · pk^{-chal} with the
+    negation folded into the scalar (group order R, so -c ≡ R - c). The
+    batched plane computes the identical point via the stage tiles."""
+    return hm.g1_add(
+        hm.g1_mul(hm.G1_GEN, resp), hm.g1_mul(pk_point, -chal % hm.R)
+    )
+
+
+def challenge(pk_point, com, message: bytes) -> int:
+    """Fiat-Shamir challenge binding (pk, commitment, message)."""
     return hm.hash_to_zr(message + g1s_bytes([pk_point, com]), b"fts/schnorr-sig")
+
+
+_challenge = challenge  # backwards-compatible private alias

@@ -131,7 +131,8 @@ class FabTokenDriver(Driver):
 
     @vguard
     def validate_transfer(self, action_bytes, resolve_input, signed_payload,
-                          signatures, now=None, proof_verified=None):
+                          signatures, now=None, proof_verified=None,
+                          sig_verified=None):
         # fabtoken carries no ZK proof: `transfer_batch_plan` never emits
         # a plan, so `proof_verified` is always None here and ignored
         d = loads(action_bytes)
@@ -156,12 +157,45 @@ class FabTokenDriver(Driver):
             )
         if len(signatures) != len(inputs):
             raise ValidationError("one signature per input owner required")
-        for t, sig in zip(inputs, signatures):
+        for si, (t, sig) in enumerate(zip(inputs, signatures)):
+            v = sig_verified.get(si) if sig_verified else None
+            if v is not None and v[0] == t.owner.raw:
+                # batched-plane verdict for THIS owner identity: the
+                # inputs==ledger check above pinned the claimed owner
+                # the verdict was computed over to ledger state
+                if not v[1]:
+                    raise ValidationError(
+                        "invalid owner signature: rejected by the batched "
+                        "signature plane"
+                    )
+                continue
             try:
                 identity.verify_signature(t.owner.raw, signed_payload, sig, now=now)
             except ValueError as e:
                 raise ValidationError(f"invalid owner signature: {e}") from e
         return ids, d["outputs"]
+
+    # ------------------------------------------------------------ batching
+
+    def transfer_sign_plan(self, action_bytes: bytes):
+        """Signature-plane hook: the ACTION-claimed input owners, one per
+        required signature. Malformed bytes return None (host path
+        rejects them with the precise error)."""
+        try:
+            d = loads(action_bytes)
+            owners = [Token.from_bytes(raw).owner.raw for raw in d["inputs"]]
+            return owners or None
+        except Exception:
+            return None
+
+    def issue_sign_plan(self, action_bytes: bytes):
+        """Signature-plane hook: fabtoken issues always require the
+        action-named issuer's signature."""
+        try:
+            issuer = loads(action_bytes)["issuer"]
+            return issuer if isinstance(issuer, bytes) and issuer else None
+        except Exception:
+            return None
 
     # ------------------------------------------------------------ tokens
 

@@ -167,7 +167,8 @@ class ZKATDLogDriver(Driver):
 
     @vguard
     def validate_transfer(self, action_bytes, resolve_input, signed_payload,
-                          signatures, now=None, proof_verified=None):
+                          signatures, now=None, proof_verified=None,
+                          sig_verified=None):
         d = loads(action_bytes)
         ids = [ID(t, i) for t, i in d["ids"]]
         if not ids:
@@ -193,7 +194,17 @@ class ZKATDLogDriver(Driver):
                 raise ValidationError(f"invalid transfer proof: {e}") from e
         if len(signatures) != len(in_tokens):
             raise ValidationError("one signature per input owner required")
-        for t, sig in zip(in_tokens, signatures):
+        for si, (t, sig) in enumerate(zip(in_tokens, signatures)):
+            v = sig_verified.get(si) if sig_verified else None
+            if v is not None and v[0] == t.owner:
+                # batched-plane verdict for THIS owner identity (only pk
+                # kinds ever get one — nym/htlc owners stay host-verified)
+                if not v[1]:
+                    raise ValidationError(
+                        "invalid owner signature: rejected by the batched "
+                        "signature plane"
+                    )
+                continue
             try:
                 identity.verify_signature(
                     t.owner, signed_payload, sig, nym_params=self.pp.nym_params,
@@ -226,6 +237,31 @@ class ZKATDLogDriver(Driver):
                 [t.data for t in out_tokens],
                 proof,
             )
+        except Exception:
+            return None
+
+    def transfer_sign_plan(self, action_bytes: bytes):
+        """Signature-plane hook: the ACTION-claimed input owners, one per
+        required signature (`validate_transfer` pins claimed inputs to
+        ledger state before any verdict is applied). Non-`pk` owner
+        kinds (nym, htlc) survive here — the pipeline's collector routes
+        them host when the identity cache yields no public key."""
+        try:
+            d = loads(action_bytes)
+            owners = [ZkToken.from_bytes(raw).owner for raw in d["inputs"]]
+            return owners or None
+        except Exception:
+            return None
+
+    def issue_sign_plan(self, action_bytes: bytes):
+        """Signature-plane hook: non-anonymous issues carry the named
+        issuer's signature; anonymous issues need none."""
+        try:
+            d = loads(action_bytes)
+            if d["anon"]:
+                return None
+            issuer = d["issuer"]
+            return issuer if isinstance(issuer, bytes) and issuer else None
         except Exception:
             return None
 

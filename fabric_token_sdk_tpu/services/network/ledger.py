@@ -342,11 +342,17 @@ class Network:
         cut_mono, cut_unix = time.monotonic(), time.time()
         timings: dict = {}
         fresh, _dups = self._split_fresh(subs, resolve_known=False)
-        verdicts = self._pipeline.proof_verdicts(
-            [s.request for s in fresh], timings
-        )
+        requests = [s.request for s in fresh]
+        verdicts = self._pipeline.proof_verdicts(requests, timings)
+        # the batched signature plane is state-independent too (payloads
+        # and identities come from request bytes), so it overlaps the
+        # previous block's commit exactly like the proof plane
+        sig_verdicts = self._pipeline.sign_verdicts(requests, timings)
         return {
             "verdicts": {id(fresh[ti]): v for ti, v in verdicts.items()},
+            "sig_verdicts": {
+                id(fresh[ti]): v for ti, v in sig_verdicts.items()
+            },
             "timings": timings,
             "cut_mono": cut_mono,
             "cut_unix": cut_unix,
@@ -422,6 +428,7 @@ class Network:
             if pre is None:
                 timings: dict = {}
                 verdicts = self._pipeline.proof_verdicts(requests, timings)
+                sig_verdicts = self._pipeline.sign_verdicts(requests, timings)
             else:
                 # stage A already verified this block (overlapping the
                 # previous block's commit): adopt its verdicts by
@@ -431,10 +438,16 @@ class Network:
                 timings = dict(pre.get("timings") or {})
                 timings.setdefault("grouping_s", 0.0)
                 timings.setdefault("device_verify_s", 0.0)
+                timings.setdefault("sign_verify_s", 0.0)
                 pv = pre.get("verdicts") or {}
                 verdicts = {
                     ti: pv[id(s)]
                     for ti, s in enumerate(fresh) if id(s) in pv
+                }
+                psv = pre.get("sig_verdicts") or {}
+                sig_verdicts = {
+                    ti: psv[id(s)]
+                    for ti, s in enumerate(fresh) if id(s) in psv
                 }
             commit_time = time.time()
             view = _BlockView(self._state, self._spent)
@@ -445,7 +458,8 @@ class Network:
                 # committing thread's — whoever wins the commit race
                 with mx.use_trace(fresh[ti].trace):
                     event = self._validate_tx(
-                        request, view, commit_time, verdicts.get(ti)
+                        request, view, commit_time, verdicts.get(ti),
+                        sig_verdicts.get(ti),
                     )
                 if fresh[ti].trace is not None:
                     event.trace_id = fresh[ti].trace.trace_id
@@ -490,6 +504,7 @@ class Network:
                 "queue_wait_max_s": round(queue_wait_max, 6),
                 "grouping_s": round(timings.get("grouping_s", 0.0), 6),
                 "device_verify_s": round(timings.get("device_verify_s", 0.0), 6),
+                "sign_verify_s": round(timings.get("sign_verify_s", 0.0), 6),
                 "host_validate_s": round(host_validate_s, 6),
                 "wal_s": round(wal_s, 6),
                 "merge_s": round(merge_s, 6),
@@ -552,13 +567,14 @@ class Network:
 
     def _validate_tx(self, request: TokenRequest, view: _BlockView,
                      commit_time: float,
-                     proofs: Optional[Dict[int, bool]]) -> FinalityEvent:
+                     proofs: Optional[Dict[int, bool]],
+                     sigs: Optional[Dict[tuple, tuple]] = None) -> FinalityEvent:
         tx_id = request.anchor
         try:
             with mx.span("network.validate", tx=tx_id):
                 result = self.validator.validate(
                     request, view.resolve, now=commit_time,
-                    transfer_proofs=proofs,
+                    transfer_proofs=proofs, sig_verified=sigs,
                 )
             view.apply(tx_id, result)
             mx.counter("network.tx.valid").inc()

@@ -81,6 +81,24 @@ SOAK_REQUIRED = {
     "backpressure_rejects": int,
 }
 
+# type-checked when present in a soak section (older rounds predate
+# them, so they must stay OPTIONAL or the gate would drop its own
+# baseline): which driver drove the corpus, what the batched signature
+# plane actually DID ("device" = rows rode the device plane,
+# "degraded" = enabled but every row fell back to host, "host" = off),
+# the host_validate leg's fraction of block commit wall time, the
+# batch.sign.* counter deltas, and the identity parse-cache hit rate
+# over the soak window
+SOAK_OPTIONAL = {
+    "driver": str,
+    "sign_plane": str,
+    "host_validate_frac": _NULLABLE_NUM,
+    "sign_rows": int,
+    "sign_host": int,
+    "sign_fallbacks": int,
+    "identity_cache_hit_rate": _NULLABLE_NUM,
+}
+
 
 def validate_soak(soak) -> List[str]:
     """Schema problems of one `soak` section (empty list = valid)."""
@@ -88,6 +106,7 @@ def validate_soak(soak) -> List[str]:
         return [f"soak is {type(soak).__name__}, expected object"]
     problems: List[str] = []
     _check(problems, soak, SOAK_REQUIRED, required=True)
+    _check(problems, soak, SOAK_OPTIONAL, required=False)
     v = soak.get("steady_txs_per_s")
     if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
         problems.append("soak.steady_txs_per_s is negative")

@@ -16,6 +16,9 @@ Registered sites (grep for `faults.fire` to confirm the live set):
     remote.recv          client-side, before a response frame is read
     batch.verify         inside the device-plane block verify (degrades
                          to host validation, never fails the block)
+    batch.sign           inside the batched signature verify (degrades
+                         every signature row to the host loop, never
+                         fails the block)
     vault.append         before a vault-journal record is written +
                          fsync'd (a failure degrades LOUDLY — counter +
                          flight event — the in-memory view still applies)

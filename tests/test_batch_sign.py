@@ -1,0 +1,540 @@
+"""Batched signature plane: differential identity with the host path.
+
+The `BatchedSchnorrVerifier` (crypto/batch_sign.py) and the block
+pipeline's obligation collection (`BlockValidationPipeline.sign_verdicts`)
+can only ACCELERATE signature checking, never change accept/reject —
+these tests pin that contract: batched vs host verdict identity over
+mixed valid/tampered rows (bit-flipped `c`, `z`, message, and pk), mixed
+identity kinds in one block (nym/htlc rows stay host), empty batches,
+min-batch routing, injected `batch.sign` faults degrading to host with
+counters asserted, the shared identity parse cache, and (FTS_WARMUP=1
+gated) a signature-batched block compiling zero new programs.
+
+The device sign plane is forced ON via `BlockPolicy(sign_batched=True)`
+here — the product default is `auto` (device only on real accelerators;
+on this CPU-emulated plane a device Schnorr row costs ~3 orders of
+magnitude more than the host check, so `auto` resolves to host).
+"""
+
+import os
+import random
+
+import pytest
+
+from fabric_token_sdk_tpu.api.request import (
+    IssueRecord,
+    TokenRequest,
+    TransferRecord,
+)
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto import sign
+from fabric_token_sdk_tpu.crypto.serialization import dumps, loads
+from fabric_token_sdk_tpu.drivers import identity
+from fabric_token_sdk_tpu.drivers.fabtoken import (
+    FabTokenDriver,
+    FabTokenPublicParams,
+)
+from fabric_token_sdk_tpu.models.token import ID
+from fabric_token_sdk_tpu.services.network import BlockPolicy, Network, TxStatus
+from fabric_token_sdk_tpu.services.network.orderer import (
+    BlockValidationPipeline,
+)
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+
+def _counter(name):
+    return mx.REGISTRY.counter(name).value
+
+
+def _host_ok(pk: sign.PublicKey, msg: bytes, sig: bytes):
+    try:
+        pk.verify(msg, sig)
+        return True
+    except ValueError:
+        return False
+
+
+# ===================================================================
+# Verifier-level differential (valid + every tamper class)
+# ===================================================================
+
+
+def test_batched_vs_host_verdicts_differential(rng):
+    """Every row class — valid, bit-flipped c, bit-flipped z, flipped
+    message, WRONG pk, unparseable blob — must agree with the host
+    verdict (None = host re-verifies, used only for unparseable)."""
+    from fabric_token_sdk_tpu.crypto.batch_sign import BatchedSchnorrVerifier
+
+    keys = [sign.keygen(rng) for _ in range(3)]
+    rows, expect = [], []
+
+    def add(pk, msg, sig_raw, want=None):
+        rows.append((pk.point, msg, sig_raw))
+        expect.append(want if want is not None else _host_ok(pk, msg, sig_raw))
+
+    for i in range(4):  # valid rows, repeated signers
+        k = keys[i % 3]
+        msg = b"pay-%d" % i
+        add(k.public, msg, k.sign(msg, rng))
+    # bit-flipped challenge
+    d = loads(keys[0].sign(b"m-c", rng))
+    d["c"] ^= 1
+    add(keys[0].public, b"m-c", dumps(d))
+    # bit-flipped response
+    d = loads(keys[1].sign(b"m-z", rng))
+    d["z"] ^= 1 << 7
+    add(keys[1].public, b"m-z", dumps(d))
+    # flipped message
+    add(keys[2].public, b"other-message", keys[2].sign(b"m-msg", rng))
+    # wrong pk for a valid signature
+    add(keys[1].public, b"m-pk", keys[0].sign(b"m-pk", rng))
+    # unparseable blob -> None (host reports the precise error)
+    rows.append((keys[0].public.point, b"m-junk", b"\x00junk"))
+    expect.append(None)
+    # non-integer fields -> None (host decides; g1_mul(pt, True) would
+    # silently coerce, so the device plane must not guess)
+    d = loads(keys[2].sign(b"m-bool", rng))
+    d["c"] = True
+    rows.append((keys[2].public.point, b"m-bool", dumps(d)))
+    expect.append(None)
+
+    v = BatchedSchnorrVerifier()
+    got = v.verify(rows)
+    assert got == expect
+    # the four valid rows really verified True
+    assert got[:4] == [True] * 4
+    # every tampered row is False on BOTH paths
+    assert got[4:8] == [False] * 4
+
+
+def test_empty_batch_is_clean():
+    from fabric_token_sdk_tpu.crypto.batch_sign import BatchedSchnorrVerifier
+
+    assert BatchedSchnorrVerifier().verify([]) == []
+
+
+def test_host_verify_response_equation_unchanged(rng):
+    """The folded-negation host path (sign.response_commitment) accepts
+    every fresh signature and rejects tampered ones — the small-fix
+    differential anchor."""
+    k = sign.keygen(rng)
+    sig = k.sign(b"hello", rng)
+    k.public.verify(b"hello", sig)  # no raise
+    d = loads(sig)
+    com = sign.response_commitment(k.public.point, d["c"], d["z"])
+    assert sign.challenge(k.public.point, com, b"hello") == d["c"]
+    with pytest.raises(ValueError):
+        k.public.verify(b"tampered", sig)
+
+
+# ===================================================================
+# Identity parse cache
+# ===================================================================
+
+
+def test_identity_cache_hits_and_bound(rng):
+    identity.cache_clear()
+    key = sign.keygen(rng)
+    ident = identity.pk_identity(key.public)
+    h0, m0 = _counter("identity.cache.hits"), _counter("identity.cache.misses")
+    for i in range(3):
+        identity.verify_signature(ident, b"m%d" % i, key.sign(b"m%d" % i, rng))
+    assert _counter("identity.cache.misses") - m0 == 1
+    assert _counter("identity.cache.hits") - h0 == 2
+    # the batched collector shares the same entry
+    pk = identity.public_key(ident)
+    assert pk is not None and pk.point == key.public.point
+    assert _counter("identity.cache.hits") - h0 == 3
+    # non-pk and malformed identities yield no public key (and malformed
+    # lookups are never cached)
+    assert identity.public_key(identity.nym_identity((1, 2))) is None
+    assert identity.public_key(b"not an identity") is None
+    # bounded: a flood of distinct identities cannot grow it past capacity
+    orig = identity._CACHE
+    identity._CACHE = identity._IdentityCache(capacity=8)
+    try:
+        for i in range(40):
+            kj = sign.keygen(rng)
+            identity.public_key(identity.pk_identity(kj.public))
+        assert identity.cache_len() == 8
+    finally:
+        identity._CACHE = orig
+    identity.cache_clear()
+    assert identity.cache_len() == 0
+
+
+# ===================================================================
+# Block-level wiring (fabtoken corpus: pk owners + issuer)
+# ===================================================================
+
+
+def _pk_corpus(tamper_kind=None, n_transfers=8):
+    """1 issue seed + a chain of n pk-signed transfers; optionally
+    tamper tx #2's owner signature (`bitflip` keeps the blob parseable —
+    a device False verdict; `garbage` makes it unparseable — a None
+    verdict the host loop rejects)."""
+    pp = FabTokenPublicParams()
+    drv = FabTokenDriver(pp)
+    key = sign.keygen(random.Random(7))
+    ident = identity.pk_identity(key.public)
+    reqs = []
+    out = drv.issue(ident, "USD", [9], [ident])
+    req = TokenRequest(anchor="seed")
+    req.issues.append(
+        IssueRecord(action=out.action_bytes, issuer=ident,
+                    outputs_metadata=out.metadata, receivers=[ident])
+    )
+    req.issues[0].signature = key.sign(req.marshal_to_sign(), random.Random(11))
+    reqs.append(req.to_bytes())
+    prev, prev_raw = ID("seed", 0), out.outputs[0]
+    for k in range(n_transfers):
+        t = drv.transfer([prev], [prev_raw], [prev_raw], "USD", [9], [ident])
+        tr = TokenRequest(anchor=f"t{k}")
+        tr.transfers.append(
+            TransferRecord(action=t.action_bytes, input_ids=[prev],
+                           senders=[ident], outputs_metadata=t.metadata,
+                           receivers=[ident])
+        )
+        sig = key.sign(tr.marshal_to_sign(), random.Random(100 + k))
+        if k == 2 and tamper_kind == "bitflip":
+            d = loads(sig)
+            d["z"] ^= 1
+            sig = dumps(d)
+        elif k == 2 and tamper_kind == "garbage":
+            sig = b"\x00garbage"
+        tr.transfers[0].signatures = [sig]
+        reqs.append(tr.to_bytes())
+        prev, prev_raw = ID(f"t{k}", 0), t.outputs[0]
+    return pp, reqs
+
+
+def _net(pp, **policy_over):
+    policy = BlockPolicy(max_block_txs=16, **policy_over)
+    return Network(RequestValidator(FabTokenDriver(pp)), policy=policy)
+
+
+def _statuses(events):
+    return [(e.tx_id, e.status) for e in events]
+
+
+def test_block_verifies_all_signatures_in_one_pass():
+    """Acceptance: a block of >= 8 pk-signed txs (8 owner sigs + 1
+    issuer sig) verifies every parseable signature in ONE
+    BatchedSchnorrVerifier pass, verdict-identical to the host path."""
+    pp, reqs = _pk_corpus()
+    b0, r0 = _counter("batch.sign.batches"), _counter("batch.sign.rows")
+    dev = _net(pp, sign_batched=True, sign_min_batch=2)
+    ev_dev = dev.submit_many(reqs)
+    b1, r1 = _counter("batch.sign.batches"), _counter("batch.sign.rows")
+    assert b1 - b0 == 1  # ONE batched call for the whole block
+    assert r1 - r0 == 9  # issuer + 8 owners, all on device
+    host = _net(pp, sign_batched=False)
+    ev_host = host.submit_many(reqs)
+    assert _counter("batch.sign.batches") == b1  # host path: no device call
+    assert _statuses(ev_dev) == _statuses(ev_host)
+    assert all(e.status == TxStatus.VALID for e in ev_dev)
+    # the SEQUENTIAL engine (no verify/commit overlap) computes sign
+    # verdicts inline and must agree too
+    seq = _net(pp, sign_batched=True, sign_min_batch=2, pipeline=False)
+    ev_seq = seq.submit_many(reqs)
+    assert _counter("batch.sign.batches") - b1 == 1
+    assert _statuses(ev_seq) == _statuses(ev_host)
+
+
+@pytest.mark.parametrize("tamper_kind", ["bitflip", "garbage"])
+def test_tampered_row_differential(tamper_kind):
+    """A tampered owner signature — parseable (device False verdict) or
+    unparseable (None -> host rejects) — invalidates exactly the txs the
+    host path invalidates (the tampered tx and its broken chain)."""
+    pp, reqs = _pk_corpus(tamper_kind=tamper_kind, n_transfers=5)
+    ev_dev = _net(pp, sign_batched=True, sign_min_batch=2).submit_many(reqs)
+    ev_host = _net(pp, sign_batched=False).submit_many(reqs)
+    assert _statuses(ev_dev) == _statuses(ev_host)
+    by_id = dict(_statuses(ev_dev))
+    assert by_id["t2"] == TxStatus.INVALID
+    assert by_id["t1"] == TxStatus.VALID
+    dev_msg = {e.tx_id: e.message for e in ev_dev}
+    assert "invalid owner signature" in dev_msg["t2"]
+
+
+def test_min_batch_routes_small_blocks_host():
+    pp, reqs = _pk_corpus(n_transfers=2)  # 3 obligations < min 4
+    b0, h0 = _counter("batch.sign.batches"), _counter("batch.sign.host")
+    ev = _net(pp, sign_batched=True, sign_min_batch=4).submit_many(reqs)
+    assert all(e.status == TxStatus.VALID for e in ev)
+    assert _counter("batch.sign.batches") == b0  # no device call
+    assert _counter("batch.sign.host") - h0 == 3  # all routed host
+
+
+def test_injected_fault_degrades_to_host():
+    """An armed `batch.sign` fault drops every row of the block back to
+    the host loop — verdicts unchanged, counters prove the degrade."""
+    pp, reqs = _pk_corpus(tamper_kind="bitflip", n_transfers=5)
+    f0 = _counter("batch.sign.host_fallbacks")
+    b0 = _counter("batch.sign.batches")
+    faults.arm("batch.sign", "error", count=1)
+    try:
+        ev = _net(pp, sign_batched=True, sign_min_batch=2).submit_many(reqs)
+    finally:
+        faults.disarm("batch.sign")
+    assert _counter("batch.sign.host_fallbacks") - f0 == 6
+    assert _counter("batch.sign.batches") == b0  # verify never completed
+    assert _counter("faults.injected.batch.sign") >= 1
+    by_id = dict(_statuses(ev))
+    assert by_id["t2"] == TxStatus.INVALID  # host still rejects the tamper
+    assert by_id["t1"] == TxStatus.VALID
+
+
+def test_construction_failure_is_latched():
+    """A failed verifier construction latches: later blocks skip even
+    the obligation collection (no per-block marshal/parse work, no
+    re-import, no log spam) and host-verify everything — the first
+    failure already counted and logged its rows."""
+    pp, reqs = _pk_corpus(n_transfers=4)
+    pipeline = BlockValidationPipeline(
+        RequestValidator(FabTokenDriver(pp)),
+        BlockPolicy(sign_batched=True, sign_min_batch=2),
+    )
+    pipeline._sign_failed = True  # as left by a construction failure
+    before = {
+        n: _counter(n) for n in
+        ("batch.sign.host_fallbacks", "batch.sign.batches",
+         "batch.sign.host", "batch.sign.rows")
+    }
+    requests = [TokenRequest.from_bytes(rb) for rb in reqs]
+    assert pipeline.sign_verdicts(requests) == {}
+    for name, v in before.items():
+        assert _counter(name) == v, name  # no work, no counters
+
+
+def test_auto_mode_resolves_host_on_cpu():
+    """The product default (`sign_batched=None` = auto) must resolve to
+    the host path on this CPU backend — the emulated device plane is
+    slower than host Schnorr, same asymmetry as the prove plane."""
+    pp, reqs = _pk_corpus(n_transfers=4)
+    pipeline = BlockValidationPipeline(
+        RequestValidator(FabTokenDriver(pp)), BlockPolicy()
+    )
+    assert pipeline.sign_enabled() is False
+    b0 = _counter("batch.sign.batches")
+    ev = _net(pp).submit_many(reqs)  # default policy: auto
+    assert all(e.status == TxStatus.VALID for e in ev)
+    assert _counter("batch.sign.batches") == b0
+
+
+# ===================================================================
+# Mixed identity kinds: nym/htlc obligations stay host
+# ===================================================================
+
+
+def test_mixed_identity_kinds_collection(rng):
+    """Collection-level contract: in one block of fabtoken txs whose
+    claimed owners span pk / nym / htlc kinds, only the pk obligations
+    become device rows — nym and htlc rows are counted host and get NO
+    verdict (the host loop would verify them unchanged)."""
+    pp = FabTokenPublicParams()
+    drv = FabTokenDriver(pp)
+    key = sign.keygen(rng)
+    pk_ident = identity.pk_identity(key.public)
+    nym_ident = identity.nym_identity((3, 4))
+    htlc_ident = identity.htlc_identity({"probe": 1})
+
+    def transfer_req(anchor, owner_ident):
+        from fabric_token_sdk_tpu.models.token import Owner, Token
+
+        raw = Token(Owner(owner_ident), "USD", hex(5)).to_bytes()
+        tid = ID("seed-" + anchor, 0)
+        t = drv.transfer([tid], [raw], [raw], "USD", [5], [pk_ident])
+        req = TokenRequest(anchor=anchor)
+        req.transfers.append(
+            TransferRecord(action=t.action_bytes, input_ids=[tid],
+                           senders=[owner_ident], outputs_metadata=t.metadata,
+                           receivers=[pk_ident])
+        )
+        req.transfers[0].signatures = [key.sign(req.marshal_to_sign(), rng)]
+        return req
+
+    requests = [
+        transfer_req("pk-a", pk_ident),
+        transfer_req("pk-b", pk_ident),
+        transfer_req("nym-a", nym_ident),
+        transfer_req("htlc-a", htlc_ident),
+    ]
+    pipeline = BlockValidationPipeline(
+        RequestValidator(FabTokenDriver(pp)),
+        BlockPolicy(sign_batched=True, sign_min_batch=2),
+    )
+    h0, r0 = _counter("batch.sign.host"), _counter("batch.sign.rows")
+    verdicts = pipeline.sign_verdicts(requests)
+    assert _counter("batch.sign.rows") - r0 == 2  # the two pk rows
+    assert _counter("batch.sign.host") - h0 == 2  # nym + htlc stay host
+    assert set(verdicts) == {0, 1}
+    for ti in (0, 1):
+        ((okey, (ident_bytes, ok)),) = verdicts[ti].items()
+        assert okey == ("transfer", 0, 0)
+        assert ident_bytes == pk_ident
+        assert ok is True
+
+
+def test_auditor_and_issue_obligations_batched(rng):
+    """Auditor + issuer signatures join the same batched pass, keyed by
+    their own obligation kinds, and a tampered auditor signature is a
+    device False that rejects the request — identically to host."""
+    pp = FabTokenPublicParams()
+    auditor_key = sign.keygen(rng)
+    auditor_ident = identity.pk_identity(auditor_key.public)
+    pp2, reqs = _pk_corpus(n_transfers=4)
+    pp2.add_auditor(auditor_ident)
+
+    def audited(reqs_bytes, tamper_idx=None):
+        out = []
+        for i, rb in enumerate(reqs_bytes):
+            req = TokenRequest.from_bytes(rb)
+            req.auditor_signature = auditor_key.sign(
+                req.marshal_to_audit(), rng
+            )
+            if i == tamper_idx:
+                d = loads(req.auditor_signature)
+                d["c"] ^= 1
+                req.auditor_signature = dumps(d)
+            out.append(req.to_bytes())
+        return out
+
+    def audited_net(sign_batched):
+        return Network(
+            RequestValidator(FabTokenDriver(pp2), auditor_ident),
+            policy=BlockPolicy(max_block_txs=16, sign_batched=sign_batched,
+                               sign_min_batch=2),
+        )
+
+    corpus = audited(reqs, tamper_idx=3)
+    r0 = _counter("batch.sign.rows")
+    ev_dev = audited_net(True).submit_many(corpus)
+    # 5 auditor sigs + 1 issuer sig + 4 owner sigs in the one pass
+    assert _counter("batch.sign.rows") - r0 == 10
+    ev_host = audited_net(False).submit_many(corpus)
+    assert _statuses(ev_dev) == _statuses(ev_host)
+    by_id = dict(_statuses(ev_dev))
+    assert by_id["t2"] == TxStatus.INVALID  # the tampered auditor sig
+    msg = {e.tx_id: e.message for e in ev_dev}["t2"]
+    assert "invalid auditor signature" in msg
+
+
+# ===================================================================
+# Soak plumbing: driver option + sign/host_validate reporting
+# ===================================================================
+
+
+class _PhaseStub:
+    def set_phase(self, name, **attrs):
+        pass
+
+
+def _run_soak(monkeypatch, tmp_path, **env):
+    import bench
+
+    defaults = {
+        "FTS_BENCH_SOAK_S": "1.2",
+        "FTS_BENCH_SOAK_CLIENTS": "1",
+        "FTS_BENCH_SOAK_GROUP": "2",
+        "FTS_SIGN_BATCHED": "0",  # emulated device plane: host loop
+        "FTS_BENCH_HISTORY": str(tmp_path / "hist.jsonl"),
+    }
+    defaults.update(env)
+    for k, v in defaults.items():
+        monkeypatch.setenv(k, v)
+    return bench._soak(_PhaseStub())
+
+
+def test_soak_reports_sign_plane_and_host_validate_frac(
+    monkeypatch, tmp_path
+):
+    """The fabtoken soak section carries the new schema-validated
+    fields: driver, sign_plane, host_validate_frac, sign/identity-cache
+    deltas — and validates under the shared schema."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    soak = _run_soak(monkeypatch, tmp_path)
+    assert benchschema.validate_soak(soak) == []
+    assert soak["driver"] == "fabtoken"
+    assert soak["sign_plane"] == "host"  # FTS_SIGN_BATCHED=0
+    assert soak["txs"] > 0
+    assert soak["host_validate_frac"] is not None
+    assert 0.0 <= soak["host_validate_frac"] <= 1.0
+    assert soak["sign_rows"] == 0 and soak["sign_fallbacks"] == 0
+    # pk identities repeat every tx: the cache must be nearly all hits
+    assert soak["identity_cache_hit_rate"] is not None
+    assert soak["identity_cache_hit_rate"] > 0.5
+
+
+@pytest.mark.slow
+def test_soak_zkatdlog_driver(monkeypatch, tmp_path, rng):
+    """FTS_BENCH_SOAK_DRIVER=zkatdlog drives chained 1-in/1-out zk
+    transfers through the same soak engine (host-proved; proof plane
+    disabled here — the emulated device path would eat the budget)."""
+    from fabric_token_sdk_tpu.crypto.setup import setup
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    import bench
+
+    zk_pp = setup(base=4, exponent=2, rng=rng)
+    for k, v in {
+        "FTS_BENCH_SOAK_S": "1.2",
+        "FTS_BENCH_SOAK_CLIENTS": "1",
+        "FTS_BENCH_SOAK_GROUP": "2",
+        "FTS_BENCH_SOAK_DRIVER": "zkatdlog",
+        "FTS_SIGN_BATCHED": "0",
+        "FTS_BLOCK_BATCHED": "0",
+        "FTS_BENCH_HISTORY": str(tmp_path / "hist.jsonl"),
+    }.items():
+        monkeypatch.setenv(k, v)
+    soak = bench._soak(_PhaseStub(), zk_pp=zk_pp)
+    assert benchschema.validate_soak(soak) == []
+    assert soak["driver"] == "zkatdlog"
+    assert soak["txs"] > 0
+
+
+def test_soak_schema_optional_fields():
+    """The new soak fields are OPTIONAL (older history rounds predate
+    them and must stay gate-eligible) but type-checked when present."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    base = {"steady_txs_per_s": 100.0, "p99_finality_s": 0.5,
+            "queue_depth_max": 10, "backpressure_rejects": 0}
+    assert benchschema.validate_soak(base) == []  # PR-12-era round
+    full = dict(base, driver="fabtoken", sign_plane="host",
+                host_validate_frac=0.4, sign_rows=0, sign_host=12,
+                sign_fallbacks=0, identity_cache_hit_rate=0.97)
+    assert benchschema.validate_soak(full) == []
+    assert benchschema.validate_soak(dict(base, driver=7))
+    assert benchschema.validate_soak(dict(base, host_validate_frac="x"))
+    assert benchschema.validate_soak(dict(base, sign_rows=0.5))
+
+
+# ===================================================================
+# Compile budget (FTS_WARMUP-gated)
+# ===================================================================
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTS_WARMUP") != "1",
+    reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",
+)
+def test_sign_batched_block_compiles_zero_programs():
+    """Post-warmup, a signature-batched block compiles NOTHING and
+    misses the persistent cache zero times: the sign plane is a
+    composition of already-canonical tiles (msm1/mul/sub)."""
+    COMPILES = "jax.core.compile.backend_compile_duration.seconds"
+    pp, reqs = _pk_corpus()
+    # absorb the one-time persistent-cache loads of the tile programs
+    warm_pp, warm_reqs = _pk_corpus(n_transfers=3)
+    _net(warm_pp, sign_batched=True, sign_min_batch=2).submit_many(warm_reqs)
+    c0 = mx.REGISTRY.histogram(COMPILES).count
+    m0 = _counter("jax.compilation_cache.cache_misses")
+    r0 = _counter("batch.sign.rows")
+    ev = _net(pp, sign_batched=True, sign_min_batch=2).submit_many(reqs)
+    assert all(e.status == TxStatus.VALID for e in ev)
+    assert _counter("batch.sign.rows") - r0 == 9  # the plane really ran
+    assert mx.REGISTRY.histogram(COMPILES).count - c0 == 0
+    assert _counter("jax.compilation_cache.cache_misses") - m0 == 0

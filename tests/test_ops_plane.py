@@ -219,6 +219,8 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
             except Exception as e:  # pragma: no cover
                 errors.append(e)
 
+        commit_h = mx.REGISTRY.histogram("ledger.block.commit.seconds")
+        pre_sum, pre_count = commit_h.sum, commit_h.count
         threads = [
             threading.Thread(target=submitter, args=(reqs[i::2],))
             for i in range(2)
@@ -226,18 +228,17 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
         for t in threads:
             t.start()
 
-        probes, peak_inflight, mid_p95 = [], 0, None
+        probes, peak_inflight, mid_hist = [], 0, None
         while any(t.is_alive() for t in threads):
             t0 = time.monotonic()
             h = probe.ops_health()
             probes.append(time.monotonic() - t0)
             peak_inflight = max(peak_inflight, h["inflight"])
-            if mid_p95 is None and h["height"] >= 2:
+            if mid_hist is None and h["height"] >= 2:
                 # mid-run metrics snapshot: quantiles served live
-                snap = probe.ops_metrics()
-                mid_p95 = snap["histograms"].get(
+                mid_hist = probe.ops_metrics()["histograms"].get(
                     "ledger.block.commit.seconds", {}
-                ).get("p95")
+                )
             time.sleep(0.02)
         for t in threads:
             t.join()
@@ -253,8 +254,18 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
     )
     # the workload was genuinely in flight while we probed
     assert peak_inflight >= 1
-    # mid-run p95 reflects the injected commit latency
-    assert mid_p95 is not None and mid_p95 >= delay_s * 0.9
+    # the mid-run snapshot served live quantiles AND saw the injected
+    # commit latency. The process registry is shared across the whole
+    # pytest session, so absolute p95/max depend on what earlier tests
+    # contributed (hundreds of fast commits from the batch-sign soak
+    # smoke, multi-second zk commits from test_orderer) — assert on the
+    # DELTA this test's own workload added instead: at least one block
+    # committed during the run, and the added wall time carries the
+    # injected delay. Quantile interpolation itself is pinned by the
+    # dedicated Histogram quantile tests above.
+    assert mid_hist is not None and mid_hist.get("p95") is not None
+    assert mid_hist.get("count", 0) > pre_count
+    assert mid_hist.get("sum", 0.0) - pre_sum >= delay_s * 0.9
     # final health is consistent (server stopped — read the ledger
     # directly): all txs finalized, nothing queued or in flight
     assert server.network.health()["txs_final"] == n_txs
@@ -267,7 +278,7 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
     # `overlap_s` rides along only when the pipelined engine is active
     assert set(lb["breakdown"]) - {"overlap_s"} == {
         "queue_wait_max_s", "grouping_s", "device_verify_s",
-        "host_validate_s", "wal_s", "merge_s",
+        "sign_verify_s", "host_validate_s", "wal_s", "merge_s",
     }
 
 
