@@ -621,7 +621,20 @@ def _soak(hb, zk_pp=None) -> dict:
     params for tests, else a small `setup()` runs outside the measured
     region). Sized by FTS_BENCH_SOAK_S / _CLIENTS / _GROUP;
     budget-aware like the scaling sweep (never outlives the armed
-    watchdog window)."""
+    watchdog window).
+
+    Chaos mode (`FTS_BENCH_SOAK_FAULTS=1`): a chaos-monkey thread
+    randomly arms/disarms injected faults for the whole window —
+    `error`/`delay`/`hang` kinds at the degrade-safe device sites
+    (`batch.verify`, `batch.sign`, where any failure falls to host with
+    verdicts unchanged) and `delay` at the fail-fast sites
+    (`wal.append`, `orderer.cut`, `selector.lock`, where an injected
+    ERROR would be a real commit failure, not a degradable one — the
+    soak asserts every acknowledged tx commits Valid). Hang caps exceed
+    the device deadline (`FTS_DEVICE_DEADLINE_S`, defaulted to 1s for
+    the chaos window when unset) so bounded dispatch + breakers actually
+    fire; the soak section gains `faults_injected` / `breaker_trips` /
+    `degraded_planes` and the run must stay live throughout."""
     import dataclasses
     import tempfile
 
@@ -648,6 +661,7 @@ def _soak(hb, zk_pp=None) -> dict:
     duration = float(os.environ.get("FTS_BENCH_SOAK_S", "12"))
     qmax = int(os.environ.get("FTS_BENCH_SOAK_QUEUE_MAX", "64"))
     driver_name = os.environ.get("FTS_BENCH_SOAK_DRIVER", "fabtoken")
+    chaos = os.environ.get("FTS_BENCH_SOAK_FAULTS", "0") == "1"
     if driver_name not in ("fabtoken", "zkatdlog"):
         raise ValueError(
             f"FTS_BENCH_SOAK_DRIVER={driver_name!r} (want fabtoken|zkatdlog)"
@@ -663,7 +677,7 @@ def _soak(hb, zk_pp=None) -> dict:
             return {}
         duration = min(duration, remaining * 0.5)
     hb.set_phase("soak", clients=clients, group=group, driver=driver_name,
-                 duration_s=round(duration, 1))
+                 duration_s=round(duration, 1), chaos=int(chaos))
     wal_path = os.path.join(
         tempfile.mkdtemp(prefix="fts-soak-wal-"), "ledger.wal"
     )
@@ -701,6 +715,22 @@ def _soak(hb, zk_pp=None) -> dict:
     }
     hv_before = mx.REGISTRY.histogram("ledger.block.host_validate.seconds").sum
     commit_before = mx.REGISTRY.histogram("ledger.block.commit.seconds").sum
+    # resilience accounting over the soak window: breaker trips, chaos
+    # fault counts, and which planes saw at least one host fallback
+    # (one counter per device plane — the single source for both the
+    # before-snapshot and the degraded_planes computation)
+    fallback_counters = (
+        "ledger.block.batch_errors",      # verify plane
+        "batch.sign.host_fallbacks",      # sign plane
+        "batch.prove.host_fallbacks",     # prove plane
+        "sharding.fallbacks",             # stages sharded dispatch
+    )
+    resil_names = ("resilience.breaker.open",) + fallback_counters
+    resil_before = {n: mx.REGISTRY.counter(n).value for n in resil_names}
+    faults_before = sum(
+        v for k, v in mx.REGISTRY.snapshot()["counters"].items()
+        if k.startswith("faults.injected.")
+    )
 
     stop = threading.Event()
     depth_peak = [0.0]
@@ -715,6 +745,48 @@ def _soak(hb, zk_pp=None) -> dict:
             with lock:
                 depth_peak[0] = max(depth_peak[0], g.value)
             stop.wait(0.02)
+
+    def chaos_monkey():
+        """Randomly arm/disarm injected faults for the soak window.
+        Degrade-safe device sites take any kind (error/delay/hang —
+        every failure falls to host, verdicts unchanged); fail-fast
+        sites take `delay` only (an injected error there is a REAL
+        commit failure, which the soak's all-Valid assertion must not
+        see). Hang caps outlive the device deadline so bounded dispatch
+        + breakers fire; every disarm releases any hung worker."""
+        from fabric_token_sdk_tpu.utils import faults, resilience
+
+        chaos_rng = random.Random(0x5EED)
+        degrade_sites = ("batch.verify", "batch.sign")
+        delay_sites = ("wal.append", "orderer.cut", "selector.lock")
+        deadline = max(0.5, resilience.device_deadline_s("verify") or 1.0)
+        hang_cap = 4.0 * deadline
+        armed_site = None
+        try:
+            while not stop.is_set():
+                if chaos_rng.random() < 0.7:
+                    site = chaos_rng.choice(degrade_sites)
+                    kind = chaos_rng.choice(("error", "delay", "hang"))
+                else:
+                    site = chaos_rng.choice(delay_sites)
+                    kind = "delay"
+                faults.arm(
+                    site, kind, prob=0.5, count=4,
+                    delay_s=hang_cap if kind == "hang" else 0.02,
+                )
+                armed_site = site
+                # a hang must stay armed PAST the device deadline or the
+                # disarm below would release the worker before bounded
+                # dispatch ever times out — the timeout/breaker path is
+                # the thing this mode exists to exercise
+                stop.wait(1.5 * deadline if kind == "hang" else 0.25)
+                faults.disarm(site)  # releases any hung worker
+                armed_site = None
+        finally:
+            if armed_site is not None:
+                faults.disarm(armed_site)
+            for site in degrade_sites + delay_sites:
+                faults.disarm(site)
 
     def client(idx):
         rng = random.Random(0xF75 + idx)
@@ -778,16 +850,36 @@ def _soak(hb, zk_pp=None) -> dict:
         for i in range(clients)
     ]
     mon = threading.Thread(target=sampler, daemon=True)
-    t_begin = time.monotonic()
-    mon.start()
-    for t in threads:
-        t.start()
-    time.sleep(duration)
-    stop.set()
-    for t in threads:
-        t.join(timeout=60)
-    elapsed = time.monotonic() - t_begin
-    mon.join(timeout=5)
+    monkey = (
+        threading.Thread(target=chaos_monkey, daemon=True) if chaos else None
+    )
+    # chaos: bounded dispatch must actually bite inside the window —
+    # default the commit-path deadline to 1s (explicit env always wins).
+    # Set/restored STRICTLY around the measured window (try/finally), so
+    # neither later bench phases nor spawned children ever inherit a 1s
+    # deadline that would open breakers against a healthy emulated
+    # backend (a cold compile there legitimately takes minutes).
+    chaos_deadline_set = chaos and "FTS_DEVICE_DEADLINE_S" not in os.environ
+    if chaos_deadline_set:
+        os.environ["FTS_DEVICE_DEADLINE_S"] = "1"
+    try:
+        t_begin = time.monotonic()
+        mon.start()
+        if monkey is not None:
+            monkey.start()
+        for t in threads:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        elapsed = time.monotonic() - t_begin
+        mon.join(timeout=5)
+        if monkey is not None:
+            monkey.join(timeout=10)
+    finally:
+        if chaos_deadline_set:
+            os.environ.pop("FTS_DEVICE_DEADLINE_S", None)
     if errors:
         raise errors[0]
     rate = committed[0] / elapsed if elapsed > 0 else 0.0
@@ -812,6 +904,19 @@ def _soak(hb, zk_pp=None) -> dict:
         mx.REGISTRY.histogram("ledger.block.commit.seconds").sum
         - commit_before
     )
+    resil_delta = {
+        n: int(mx.REGISTRY.counter(n).value - before)
+        for n, before in resil_before.items()
+    }
+    faults_injected = int(
+        sum(
+            v for k, v in mx.REGISTRY.snapshot()["counters"].items()
+            if k.startswith("faults.injected.")
+        )
+        - faults_before
+    )
+    # planes whose host fallback fired at least once during the window
+    degraded_planes = sum(1 for n in fallback_counters if resil_delta[n] > 0)
     soak = {
         "steady_txs_per_s": round(rate, 2),
         "p99_finality_s": round(p99, 4) if p99 is not None else None,
@@ -841,6 +946,13 @@ def _soak(hb, zk_pp=None) -> dict:
             round(sign_delta["identity.cache.hits"] / cache_lookups, 4)
             if cache_lookups else None
         ),
+        # resilience accounting of the window: injected chaos volume,
+        # breaker trips, and how many device planes degraded to host at
+        # least once — all zero in a clean (non-chaos) soak, and the
+        # node stayed live + all-Valid either way
+        "faults_injected": faults_injected,
+        "breaker_trips": resil_delta["resilience.breaker.open"],
+        "degraded_planes": degraded_planes,
     }
     mx.gauge("bench.soak_txs_per_s").set(soak["steady_txs_per_s"])
     if p99 is not None:

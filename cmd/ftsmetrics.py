@@ -162,6 +162,25 @@ def show(path: str, prometheus: bool = False) -> None:
             f" identity_cache_hit_rate={hit_rate:.2f}"
         )
 
+    # one-line resilience health: circuit-breaker transitions, open-
+    # breaker rejections, bounded-dispatch timeouts and abandoned-worker
+    # straggler completions — nonzero opens/timeouts mean a device plane
+    # was degraded and the commit path rode its host fallback
+    r_open = ctr.get("resilience.breaker.open", 0)
+    r_close = ctr.get("resilience.breaker.close", 0)
+    r_probe = ctr.get("resilience.breaker.probe", 0)
+    r_rej = ctr.get("resilience.breaker.rejected", 0)
+    b_calls = ctr.get("resilience.bounded.calls", 0)
+    b_to = ctr.get("resilience.bounded.timeouts", 0)
+    b_strag = ctr.get("resilience.bounded.stragglers", 0)
+    if r_open or r_rej or b_to or b_calls:
+        print(
+            f"resilience summary: breaker_opens={r_open} closes={r_close}"
+            f" probes={r_probe} rejected={r_rej}"
+            f" bounded_calls={b_calls} timeouts={b_to}"
+            f" stragglers={b_strag}"
+        )
+
     # one-line tracing health: how many distributed traces / trace-tagged
     # spans this run produced, flight-recorder traffic, and ring dumps
     # (assemble the actual timelines with cmd/ftstrace.py)

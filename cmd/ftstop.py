@@ -111,6 +111,18 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
         f"rss={_mb(gauges.get('proc.rss.bytes'))}",
         f"dev_mem={_mb(gauges.get('device.mem.bytes'))}",
     ]
+    # circuit-breaker column (resilience layer): `brk=ok` while every
+    # plane that ever dispatched is closed, else the degraded planes and
+    # their states — the live "a device plane is riding its host
+    # fallback" signal. Absent entirely on nodes predating the field.
+    breakers = health.get("breakers")
+    if breakers is not None:
+        degraded = {p: s for p, s in breakers.items() if s != "closed"}
+        parts.append(
+            "brk="
+            + (",".join(f"{p}:{s}" for p, s in sorted(degraded.items()))
+               if degraded else "ok")
+        )
     wal = health.get("wal")
     if wal:
         parts.append(
@@ -389,7 +401,10 @@ def compare_soak(args) -> int:
             f"backpressure={s['backpressure_rejects']} "
             f"driver={s.get('driver', 'fabtoken')} "
             f"sign={s.get('sign_plane', '-')} "
-            f"host_validate_frac={s.get('host_validate_frac', '-')}"
+            f"host_validate_frac={s.get('host_validate_frac', '-')} "
+            f"faults={s.get('faults_injected', 0)} "
+            f"breaker_trips={s.get('breaker_trips', 0)} "
+            f"degraded_planes={s.get('degraded_planes', 0)}"
         ),
     )
 

@@ -35,7 +35,7 @@ from .wellformedness import TransferWF, challenge_transfer_wf
 from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
     stages as st, tower as tw
 from ..parallel.sharding import MeshConfig
-from ..utils import metrics as mx
+from ..utils import metrics as mx, resilience
 
 # Canonical tile height for all stage kernels (re-exported for compat;
 # the runner lives in ops/stages.py).
@@ -383,7 +383,17 @@ class BatchedTransferVerifier(_MeshBound):
         B = len(txs)
         if B == 0:
             return np.zeros(0, dtype=bool)
-        mx.counter("batch.transfer.txs").inc(B)
+
+        def _count_done():
+            # counted on COMPLETION (not entry): an ABANDONED bounded
+            # worker (verify timeout already degraded the block to host)
+            # must not report its discarded txs as device-verified —
+            # they were counted under ledger.validate.host instead. An
+            # entry-side count would always precede the deadline expiry
+            # and defeat the guard.
+            if not resilience.call_abandoned():
+                mx.counter("batch.transfer.txs").inc(B)
+
         n_in, n_out = len(txs[0][0]), len(txs[0][1])
         proofs = []
         ok = np.ones(B, dtype=bool)
@@ -398,6 +408,7 @@ class BatchedTransferVerifier(_MeshBound):
         )
         ok &= wf_ok
         if n_in == 1 and n_out == 1:
+            _count_done()
             return ok
 
         rp = self.pp.range_params
@@ -444,6 +455,7 @@ class BatchedTransferVerifier(_MeshBound):
         # ---- equality proofs: token rows (3 bases) + aggregate rows (2)
         live = [i for i in range(B) if ranges[i] is not None]
         if not live:
+            _count_done()
             return ok
         L = lb.NLIMBS
         nl = len(live)
@@ -508,4 +520,5 @@ class BatchedTransferVerifier(_MeshBound):
             )
             if chal != rpf.challenge:
                 ok[i] = False
+        _count_done()
         return ok

@@ -40,7 +40,7 @@ from .setup import PublicParams
 from .transfer import TransferProof, _skip_range
 from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
     stages as st, tower as tw
-from ..utils import metrics as mx
+from ..utils import metrics as mx, resilience
 
 
 class BatchedTransferProver(_MeshBound):
@@ -282,9 +282,12 @@ class BatchedTransferProver(_MeshBound):
                 with mx.span("batch.prove.range"):
                     ranges = self._prove_range(reqs, n_out, rng)
         # counted on COMPLETION (a device-plane failure re-proves the
-        # group on host — those txs land in batch.prove.host instead)
-        mx.counter("batch.prove.batches").inc()
-        mx.counter("batch.prove.txs").inc(len(reqs))
+        # group on host — those txs land in batch.prove.host instead,
+        # and so do the txs of an ABANDONED bounded worker finishing
+        # late: its proofs are discarded, they must not report device)
+        if not resilience.call_abandoned():
+            mx.counter("batch.prove.batches").inc()
+            mx.counter("batch.prove.txs").inc(len(reqs))
         return [
             TransferProof(wf=w, range_correctness=rc).to_bytes()
             for w, rc in zip(wfs, ranges)

@@ -38,7 +38,7 @@ from .batch import _MeshBound, _spanned
 from .serialization import loads
 from ..ops import curve as cv
 from ..parallel.sharding import sharded_schnorr_rows
-from ..utils import metrics as mx
+from ..utils import metrics as mx, resilience
 
 
 class BatchedSchnorrVerifier(_MeshBound):
@@ -97,8 +97,11 @@ class BatchedSchnorrVerifier(_MeshBound):
         )
         com_pts = cv.decode_points(coms)
         # counted on COMPLETION only (PR-9 precedent): a device failure
-        # above falls to host and must never report as device-verified
-        mx.counter("batch.sign.rows").inc(len(live))
+        # above falls to host and must never report as device-verified —
+        # nor may an ABANDONED bounded worker that completes late (its
+        # rows were already counted as host fallbacks by the caller)
+        if not resilience.call_abandoned():
+            mx.counter("batch.sign.rows").inc(len(live))
         for j, i in enumerate(live):
             pk_point, message, _sig = rows[i]
             verdicts[i] = (

@@ -8,6 +8,7 @@ range proof is skipped for 1-in-1-out ownership transfers) and
 from __future__ import annotations
 
 import os
+import random as _random
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -15,7 +16,7 @@ from . import hostmath as hm, rangeproof, wellformedness as wf
 from .setup import PublicParams
 from .serialization import guard, dumps, loads
 from .token import TokenDataWitness
-from ..utils import metrics as mx
+from ..utils import metrics as mx, resilience
 
 
 def _prove_min_batch() -> int:
@@ -110,7 +111,11 @@ class TransferProver:
         device plane (`crypto/batch_prove.py` over the `ops/stages.py`
         tiles). Degrade-only contract, same as block validation: ANY
         device-plane error falls back to the host prover for that group
-        — batching can only accelerate, never lose, a proof.
+        — batching can only accelerate, never lose, a proof. Each group
+        dispatch is bounded (`FTS_DEVICE_DEADLINE_S`, prove plane:
+        unbounded by default) and guarded by the `prove` circuit
+        breaker (utils/resilience.py): when open, groups host-prove
+        immediately; a half-open probe re-engages the device plane.
 
         `requests`: tuples of `(in_witnesses, out_witnesses, inputs,
         outputs)` — the host constructor's arguments. Returns proof bytes
@@ -141,21 +146,57 @@ class TransferProver:
                 if fallback:
                     mx.counter("batch.prove.host_fallbacks").inc()
 
+        brk = resilience.breaker("prove")
+        deadline_s = resilience.device_deadline_s("prove")
         for shape, indices in sorted(groups.items()):
             if len(indices) < min_batch:
                 host(indices)
                 continue
+            if not brk.allow():
+                # open breaker: the device prove plane is sick — host-
+                # prove this group immediately instead of paying another
+                # failure/deadline (no fallback count: no device error
+                # happened on THIS group)
+                host(indices)
+                continue
+            if deadline_s > 0:
+                # bounded dispatch may ABANDON the device worker mid-
+                # prove; each group's worker must own an independent rng
+                # stream (forked by one atomic draw per group, on the
+                # caller's thread) or a straggler would race the host
+                # fallback's — or the NEXT group's — draws on a shared
+                # rng. Unbounded dispatch runs inline with the caller's
+                # rng — proof bytes stay deterministic under a fixed
+                # seed.
+                dev_rng = _random.Random(
+                    rng.getrandbits(64) if rng is not None else None
+                )
+            else:
+                dev_rng = rng
             try:
                 if prover is None:
                     # lazy: host-only callers never pull in the jax stack
                     from .batch_prove import prover_for
 
                     prover = prover_for(pp)
-                proofs = prover.prove([reqs[i] for i in indices], rng)
+
+                def _device_prove(prover=prover, rng=dev_rng,
+                                  group=[reqs[i] for i in indices]):
+                    return prover.prove(group, rng)
+
+                proofs = resilience.bounded_call(
+                    _device_prove, deadline_s, plane="prove"
+                )
                 for i, p in zip(indices, proofs):
                     out[i] = p
-            except Exception:
+            except resilience.DeviceTimeout:
+                brk.record_failure(timeout=True)
                 host(indices, fallback=True)
+            except Exception:
+                brk.record_failure()
+                host(indices, fallback=True)
+            else:
+                brk.record_success()
         return out
 
 

@@ -47,7 +47,7 @@ from jax import lax
 from . import curve as cv, curve2 as cv2, limbs as lb
 from .field import FP
 from ..utils import metrics as mx
-from ..utils import sysmon
+from ..utils import resilience, sysmon
 from ..utils.tracing import logger
 
 # Canonical tile height: every stage kernel sees exactly ROW_TILE flat
@@ -183,8 +183,17 @@ def run_tile_spans(fn, ntiles: int, workers: int, *args, calls, shards,
     accept/reject can never depend on sharding. `calls`/`shards` are
     incremented on COMPLETION only: a degraded dispatch must never
     report as sharded (tests and the observatory both read these as
-    "the sharded path actually ran")."""
+    "the sharded path actually ran").
+
+    The `stages` circuit breaker (utils/resilience.py) guards this
+    seam: repeated dispatch failures OPEN it and later calls skip
+    straight to the sequential walk (no thread pool spun up, no
+    re-failure paid) until a half-open probe heals it — the plane
+    degrades AND recovers without operator action."""
     if workers <= 1 or ntiles <= 1:
+        return fn(*args, 0, ntiles)
+    brk = resilience.breaker("stages")
+    if not brk.allow():
         return fn(*args, 0, ntiles)
     try:
         spans = dp_spans(ntiles, workers)
@@ -193,8 +202,10 @@ def run_tile_spans(fn, ntiles: int, workers: int, *args, calls, shards,
             outs = [o for f in futs for o in f.result()]
         calls.inc()
         shards.inc(len(spans))
+        brk.record_success()
         return outs
     except Exception:
+        brk.record_failure()
         mx.counter("sharding.fallbacks").inc()
         logger.exception(
             "%s: sharded dispatch failed (workers=%d); re-running "

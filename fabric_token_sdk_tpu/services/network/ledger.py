@@ -33,7 +33,7 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator, ValidationResult
 from ...models.token import ID
-from ...utils import faults
+from ...utils import faults, resilience
 from ...utils import metrics as mx
 from ...utils.tracing import logger, tracer
 from .orderer import (
@@ -226,6 +226,11 @@ class Network:
             "inflight": self._orderer.inflight(),
             "wal": wal,
             "last_block": last,
+            # per-plane circuit-breaker states (utils/resilience.py):
+            # {} until a plane dispatched at least once; a non-"closed"
+            # entry is the live signal a device plane is degraded and
+            # riding its host fallback (ftstop renders the brk column)
+            "breakers": resilience.breaker_states(),
         }
 
     # ------------------------------------------------------------ ordering

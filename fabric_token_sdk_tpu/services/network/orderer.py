@@ -47,7 +47,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ...api.request import TokenRequest
 from ...api.validator import SIG_AUDITOR, RequestValidator
 from ...drivers import identity
-from ...utils import faults
+from ...utils import faults, resilience
 from ...utils import metrics as mx
 from ...utils.tracing import logger
 
@@ -391,6 +391,14 @@ class BlockValidationPipeline:
     dispatch. The degrade chain is sharded -> unsharded (inside the
     runners, `sharding.fallbacks`) -> host (here, `ledger.block.
     batch_errors`): accept/reject never depends on the mesh.
+
+    Resilience (utils/resilience.py): each device dispatch runs under
+    `bounded_call` with the plane's `FTS_DEVICE_DEADLINE_S` wall budget
+    (a hung XLA call is abandoned at the deadline, its late result
+    discarded, and the block falls to host), and each plane carries a
+    circuit breaker — repeated failures/timeouts OPEN it so later
+    blocks skip straight to host with no deadline paid, and a half-open
+    probe after cooldown re-engages the device plane by itself.
     """
 
     def __init__(self, validator: RequestValidator, policy: BlockPolicy,
@@ -400,12 +408,13 @@ class BlockValidationPipeline:
         self.mesh = mesh
         # batched signature plane state: the verifier is built lazily on
         # first use (jax import); `sign_batched=None` (auto) resolves
-        # once against the live backend. A construction failure is
-        # LATCHED — the degrade decision is stable for the process
-        # lifetime, so later blocks skip straight to host instead of
-        # re-importing and re-logging on the commit path.
+        # once against the live backend. A construction failure records
+        # into the `sign` circuit breaker (utils/resilience.py) — an
+        # open breaker skips even obligation collection until its
+        # cooldown expires and a half-open probe re-tries, so a
+        # transient failure (one-off OOM) heals instead of disabling
+        # device signatures for the process lifetime.
         self._sign_verifier = None
-        self._sign_failed = False
         self._sign_auto: Optional[bool] = None
 
     def proof_verdicts(
@@ -439,8 +448,19 @@ class BlockValidationPipeline:
 
         verdicts: Dict[int, Dict[int, bool]] = {}
         verifier = None
+        brk = resilience.breaker("verify")
+        deadline_s = resilience.device_deadline_s("verify")
         for shape, rows in sorted(groups.items()):
             if len(rows) < max(1, self.policy.min_batch):
+                continue
+            if not brk.allow():
+                # open breaker: instant host fallback — no deadline paid,
+                # no worker stacked onto a sick backend. The host plane
+                # re-verifies these rows with verdicts unchanged.
+                mx.flight(
+                    "verify.host_fallback", shape=str(shape),
+                    txs=len(rows), reason="breaker_open",
+                )
                 continue
             if verifier is None:
                 try:
@@ -454,23 +474,47 @@ class BlockValidationPipeline:
                     # construction failures (device stack unavailable,
                     # OOM building tables) degrade to host validation,
                     # same as verify failures — never fail a block
+                    brk.record_failure()
                     mx.counter("ledger.block.batch_errors").inc()
                     mx.flight("verify.host_fallback", reason="construct")
                     return verdicts
                 if verifier is None:
+                    # the driver HAS no batched plane: neither success
+                    # nor failure — release the admission (else a
+                    # half-open probe would stay consumed forever)
+                    brk.cancel_probe()
                     return verdicts
+
+            def _device_verify(rows=rows):
+                # device-plane fault point: firing here (INSIDE the
+                # bounded worker, so a `hang` kind is governed by the
+                # deadline) exercises the degrade-to-host path below
+                faults.fire("batch.verify")
+                return verifier.verify([row for _, _, row in rows])
+
             tg = time.monotonic()
             try:
                 with mx.span(
                     "ledger.block.batch_verify", shape=str(shape), txs=len(rows)
                 ):
-                    # device-plane fault point: firing here exercises the
-                    # degrade-to-host path below (verdicts must not change)
-                    faults.fire("batch.verify")
-                    ok = verifier.verify([row for _, _, row in rows])
+                    ok = resilience.bounded_call(
+                        _device_verify, deadline_s, plane="verify"
+                    )
+            except resilience.DeviceTimeout:
+                # the dispatch outlived its wall budget: abandon it (the
+                # straggler's late result is discarded by the supervisor)
+                # and fall to host — the block must not stall
+                brk.record_failure(timeout=True)
+                mx.counter("ledger.block.batch_errors").inc()
+                mx.flight(
+                    "verify.host_fallback", shape=str(shape),
+                    txs=len(rows), reason="timeout",
+                )
+                continue
             except Exception:
                 # the host plane re-verifies these rows; never fail a block
                 # on a device-plane error
+                brk.record_failure()
                 mx.counter("ledger.block.batch_errors").inc()
                 mx.flight(
                     "verify.host_fallback", shape=str(shape), txs=len(rows)
@@ -478,6 +522,7 @@ class BlockValidationPipeline:
                 continue
             finally:
                 timings["device_verify_s"] += time.monotonic() - tg
+            brk.record_success()
             mx.flight(
                 "verify.device", shape=str(shape), txs=len(rows),
                 ok=int(sum(1 for g in ok if g)),
@@ -583,19 +628,25 @@ class BlockValidationPipeline:
         signature obligations of a block. Returns
         `{tx_index: {obligation_key: (identity_bytes, bool)}}` for
         `RequestValidator.validate(sig_verified=...)`. The degrade chain
-        is the proof plane's: any device error (or verifier construction
-        failure) drops every row to the host loop
-        (`batch.sign.host_fallbacks`) — accept/reject can never depend
-        on this plane. `timings` gains `sign_verify_s` (time inside the
-        batched call, including failed ones)."""
+        is the proof plane's: any device error, deadline timeout, or
+        verifier construction failure drops every row to the host loop
+        (`batch.sign.host_fallbacks`) and records into the `sign`
+        circuit breaker — accept/reject can never depend on this plane,
+        and an OPEN breaker skips even the obligation collection until
+        a half-open probe heals it (replacing the old process-lifetime
+        construction-failure latch). `timings` gains `sign_verify_s`
+        (time inside the batched call, including failed ones)."""
         if timings is None:
             timings = {}
         timings.setdefault("sign_verify_s", 0.0)
-        if not self.sign_enabled() or self._sign_failed:
-            # latched construction failure: skip even the collection —
-            # the plane is off for the process lifetime, and the first
-            # failure already counted/logged its rows; later blocks
-            # must not pay per-block marshal/parse work for nothing
+        if not self.sign_enabled():
+            return {}
+        brk = resilience.breaker("sign")
+        if brk.rejecting():
+            # open breaker (cooldown running): skip even the collection —
+            # later blocks must not pay per-block marshal/parse work
+            # against a plane known sick; the half-open probe after
+            # cooldown re-engages it off this fast path
             return {}
         rows, keys, host = self._collect_sign_obligations(requests)
         if host:
@@ -605,28 +656,58 @@ class BlockValidationPipeline:
         if len(rows) < max(1, self.policy.sign_min_batch):
             mx.counter("batch.sign.host").inc(len(rows))
             return {}
+        if not brk.allow():
+            # raced another thread's half-open probe: host-verify this
+            # block rather than stacking a second dispatch on the probe
+            mx.counter("batch.sign.host").inc(len(rows))
+            mx.flight(
+                "sign.host_fallback", rows=len(rows), reason="breaker_open"
+            )
+            return {}
         if self._sign_verifier is None:
             try:
                 from ...crypto.batch_sign import BatchedSchnorrVerifier
 
                 self._sign_verifier = BatchedSchnorrVerifier(mesh=self.mesh)
             except Exception:
-                self._sign_failed = True  # latched: no per-block retries
+                # one strike, like the latch this breaker replaced: a
+                # construction failure is structural (import/OOM) and
+                # per-block retries only re-pay marshal/import/log cost
+                # — trip immediately; the half-open probe still heals a
+                # transient one after cooldown
+                brk.record_failure(trip_now=True)
                 mx.counter("batch.sign.host_fallbacks").inc(len(rows))
                 mx.flight("sign.host_fallback", reason="construct")
                 logger.exception(
                     "sign plane: verifier construction failed; block "
-                    "signatures host-verify from here on"
+                    "signatures host-verify (breaker heals via probe)"
                 )
                 return {}
+
+        def _device_sign():
+            # device-plane fault point: inside the bounded worker, so a
+            # `hang` kind is governed by the deadline, never the block
+            faults.fire("batch.sign")
+            return self._sign_verifier.verify(rows)
+
         t0 = time.monotonic()
         try:
             with mx.span("ledger.block.batch_sign", rows=len(rows)):
-                # device-plane fault point: firing exercises the
-                # degrade-to-host path (verdicts must not change)
-                faults.fire("batch.sign")
-                verdicts = self._sign_verifier.verify(rows)
+                verdicts = resilience.bounded_call(
+                    _device_sign, resilience.device_deadline_s("sign"),
+                    plane="sign",
+                )
+        except resilience.DeviceTimeout:
+            brk.record_failure(timeout=True)
+            mx.counter("batch.sign.host_fallbacks").inc(len(rows))
+            mx.flight("sign.host_fallback", rows=len(rows), reason="timeout")
+            logger.warning(
+                "sign plane: batched verify timed out; block signatures "
+                "host-verify (worker abandoned, result discarded)"
+            )
+            return {}
         except Exception:
+            brk.record_failure()
             mx.counter("batch.sign.host_fallbacks").inc(len(rows))
             mx.flight("sign.host_fallback", rows=len(rows))
             logger.exception(
@@ -636,6 +717,7 @@ class BlockValidationPipeline:
             return {}
         finally:
             timings["sign_verify_s"] += time.monotonic() - t0
+        brk.record_success()
         out: Dict[int, Dict[tuple, tuple]] = {}
         device = 0
         for (ti, okey, ident), v in zip(keys, verdicts):

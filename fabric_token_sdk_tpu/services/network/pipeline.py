@@ -30,10 +30,16 @@ in `tests/test_pipeline.py`):
 * **Height order** — stage B is a single consumer of a FIFO queue fed
   under `stage_lock`; merges happen in exactly cut order.
 * **Degrade chain** — stage A is `BlockValidationPipeline.proof_verdicts`
-  unchanged: sharded -> unsharded -> host per block. A verify-stage
-  exception (outside the pipeline's own degrade handling) downgrades to
-  `pre=None`, making stage B re-run verification exactly as the
-  sequential engine would (`orderer.pipeline.verify_errors`).
+  unchanged: sharded -> unsharded -> host per block, with each device
+  dispatch bounded by the plane's `FTS_DEVICE_DEADLINE_S` wall budget
+  and guarded by its circuit breaker (utils/resilience.py) — a hung
+  XLA call is abandoned at the deadline inside stage A itself, so it
+  can never wedge the driving thread, and BOTH engines inherit the
+  same seam because the sequential path calls the same pipeline
+  methods. A verify-stage exception (outside the pipeline's own
+  degrade handling, which never raises) downgrades to `pre=None`,
+  making stage B re-run verification exactly as the sequential engine
+  would (`orderer.pipeline.verify_errors`).
 * **Exactly-once** — dedup at stage A is provisional (skip work already
   recorded); stage B re-checks under the final committed state, so a
   duplicate racing across two in-flight blocks resolves from the

@@ -97,6 +97,15 @@ SOAK_OPTIONAL = {
     "sign_host": int,
     "sign_fallbacks": int,
     "identity_cache_hit_rate": _NULLABLE_NUM,
+    # resilience accounting (rounds predating the chaos-soak mode omit
+    # them): how many faults the chaos monkey landed
+    # (`FTS_BENCH_SOAK_FAULTS=1`, else 0), how many times a circuit
+    # breaker OPENED during the window, and how many device planes saw
+    # at least one host fallback — the proof the node degraded AND
+    # stayed live rather than stalling
+    "faults_injected": int,
+    "breaker_trips": int,
+    "degraded_planes": int,
 }
 
 

@@ -40,9 +40,16 @@ Arming:
 Kinds: ``error`` raises ``FaultInjected``; ``drop`` raises
 ``FaultConnectionDrop`` (a ``ConnectionError``, so transport-level retry
 paths treat it exactly like a real dead socket); ``delay`` sleeps
-``delay_s`` then returns. Every firing increments the
-``faults.injected.<site>`` counter, so a chaos run's sidecar records
-exactly what was injected where.
+``delay_s`` then returns; ``hang`` BLOCKS the firing thread until the
+site is disarmed (``disarm``/``clear`` release it) or a cap expires
+(``delay_s``, default ``HANG_CAP_S`` = 120s when unspecified) — the
+fault kind that models an indefinite device stall, which ``delay``
+cannot (its sleep always returns on schedule). The resilience layer's
+bounded dispatch (`utils/resilience.py`) is tested against ``hang``:
+the hung worker is abandoned at the deadline and released here at
+disarm/cap. Every firing increments the ``faults.injected.<site>``
+counter, so a chaos run's sidecar records exactly what was injected
+where.
 """
 
 from __future__ import annotations
@@ -65,7 +72,12 @@ class FaultConnectionDrop(ConnectionError):
     """An armed `drop`-kind fault point fired (transport-shaped)."""
 
 
-_KINDS = ("error", "drop", "delay")
+_KINDS = ("error", "drop", "delay", "hang")
+
+# default cap of a `hang` firing when no explicit delay_s is armed: long
+# enough that only a deadline-bounded caller escapes it, short enough
+# that a hung worker thread is eventually released even if nobody disarms
+HANG_CAP_S = 120.0
 
 
 @dataclass
@@ -76,6 +88,7 @@ class _Armed:
     remaining: Optional[int] = None  # None = unlimited firings
     delay_s: float = 0.05
     exc: Optional[BaseException] = None  # overrides the default exception
+    release: Optional[threading.Event] = None  # hang: set on disarm/clear
 
 
 _armed: Dict[str, _Armed] = {}
@@ -85,23 +98,36 @@ _rng = random.Random(int(os.environ.get("FTS_FAULTS_SEED", "0xF75"), 0))
 
 
 def arm(site: str, kind: str = "error", prob: float = 1.0,
-        count: Optional[int] = None, delay_s: float = 0.05,
+        count: Optional[int] = None, delay_s: Optional[float] = None,
         exc: Optional[BaseException] = None) -> None:
-    """Arm `site` to fire `count` times (None = forever) with `prob`."""
+    """Arm `site` to fire `count` times (None = forever) with `prob`.
+    For `hang`, `delay_s` is the release CAP (default `HANG_CAP_S`)."""
     if kind not in _KINDS:
         raise ValueError(f"unknown fault kind {kind!r} (want one of {_KINDS})")
+    if delay_s is None:
+        delay_s = HANG_CAP_S if kind == "hang" else 0.05
+    release = threading.Event() if kind == "hang" else None
     with _lock:
-        _armed[site] = _Armed(site, kind, prob, count, delay_s, exc)
+        old = _armed.get(site)
+        _armed[site] = _Armed(site, kind, prob, count, delay_s, exc, release)
+    if old is not None and old.release is not None:
+        old.release.set()  # re-arming must not strand earlier hangers
 
 
 def disarm(site: str) -> None:
     with _lock:
-        _armed.pop(site, None)
+        f = _armed.pop(site, None)
+    if f is not None and f.release is not None:
+        f.release.set()  # release any thread blocked in a hang firing
 
 
 def clear() -> None:
     with _lock:
+        fs = list(_armed.values())
         _armed.clear()
+    for f in fs:
+        if f.release is not None:
+            f.release.set()
 
 
 def armed() -> Dict[str, str]:
@@ -125,13 +151,20 @@ def fire(site: str) -> None:
             return
         if f.remaining is not None:
             f.remaining -= 1
-        kind, delay_s, exc = f.kind, f.delay_s, f.exc
+        kind, delay_s, exc, release = f.kind, f.delay_s, f.exc, f.release
     mx.counter(f"faults.injected.{site}").inc()
     # flight-record the firing with the ACTIVE trace id, so a chaos run
     # can correlate each injected fault to the exact tx it hit
     mx.flight("fault", site=site, fault_kind=kind)
     if kind == "delay":
         time.sleep(delay_s)
+        return
+    if kind == "hang":
+        # an indefinite stall, bounded only by disarm()/clear() or the
+        # armed cap — the firing thread then RETURNS (the stall ended;
+        # the call it was injected into proceeds normally, so a caller
+        # that abandoned it at a deadline sees a straggler completion)
+        release.wait(delay_s)
         return
     if exc is not None:
         raise exc
@@ -157,7 +190,8 @@ def load_env(spec: Optional[str] = None) -> int:
         site, kind = fields[0], fields[1]
         prob = float(fields[2]) if len(fields) > 2 else 1.0
         count = int(fields[3]) if len(fields) > 3 else None
-        delay_s = float(fields[4]) if len(fields) > 4 else 0.05
+        # None lets arm() pick the per-kind default (hang: HANG_CAP_S)
+        delay_s = float(fields[4]) if len(fields) > 4 else None
         arm(site, kind, prob=prob, count=count, delay_s=delay_s)
         n += 1
     return n

@@ -60,9 +60,12 @@ def rng():
 @pytest.fixture(autouse=True)
 def _disarm_faults():
     """No fault armed in one test may leak into the next (the fault
-    registry is process-global by design — see utils/faults.py)."""
+    registry is process-global by design — see utils/faults.py), and no
+    tripped circuit breaker may reject the next test's device dispatch
+    (the breaker registry is process-global too)."""
     yield
-    from fabric_token_sdk_tpu.utils import faults
+    from fabric_token_sdk_tpu.utils import faults, resilience
 
     if faults.armed():
         faults.clear()
+    resilience.reset()

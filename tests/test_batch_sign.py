@@ -286,17 +286,25 @@ def test_injected_fault_degrades_to_host():
     assert by_id["t1"] == TxStatus.VALID
 
 
-def test_construction_failure_is_latched():
-    """A failed verifier construction latches: later blocks skip even
-    the obligation collection (no per-block marshal/parse work, no
-    re-import, no log spam) and host-verify everything — the first
-    failure already counted and logged its rows."""
+def test_open_breaker_skips_collection():
+    """An OPEN sign breaker (as left by construction failures) keeps
+    the old latch's fast path: later blocks skip even the obligation
+    collection (no per-block marshal/parse work, no re-import, no log
+    spam) and host-verify everything — but unlike the latch, the plane
+    re-engages via the half-open probe once the cooldown expires
+    (pinned in tests/test_resilience.py)."""
+    from fabric_token_sdk_tpu.utils import resilience
+
     pp, reqs = _pk_corpus(n_transfers=4)
     pipeline = BlockValidationPipeline(
         RequestValidator(FabTokenDriver(pp)),
         BlockPolicy(sign_batched=True, sign_min_batch=2),
     )
-    pipeline._sign_failed = True  # as left by a construction failure
+    brk = resilience.breaker("sign")
+    brk.cooldown_s = 60.0  # hold the breaker open for the whole test
+    brk.record_failure(timeout=True)
+    brk.record_failure(timeout=True)  # consecutive timeouts: OPEN
+    assert brk.state == "open"
     before = {
         n: _counter(n) for n in
         ("batch.sign.host_fallbacks", "batch.sign.batches",

@@ -19,6 +19,7 @@ Proves the PR-7 invariants under injected faults (`utils/faults.py`):
   request"), oversized frames are rejected before allocation, and remote
   finality listeners get per-listener crash isolation.
 """
+import json
 import os
 import random
 import select
@@ -739,3 +740,159 @@ def test_sigkill_ledger_server_recovers_from_wal(tmp_path):
         for c in (child, child2):
             if c is not None and c.poll() is None:
                 c.kill()
+
+
+# ===================================================================
+# Hung-device chaos: bounded dispatch + breaker keep a node live
+# ===================================================================
+
+_HANG_CHILD = """
+import json, os, random, sys, time
+sys.path.insert(0, sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["FTS_BREAKER_TIMEOUTS"] = "1"     # one timeout opens the plane
+# wide enough that host re-validation of the hung block + client-side
+# proving of the next block land INSIDE the cooldown on a loaded 2-core
+# host — the "rej" block must hit an OPEN breaker, not become the probe
+os.environ["FTS_BREAKER_COOLDOWN_S"] = "20.0"
+from fabric_token_sdk_tpu.api.request import (
+    IssueRecord, TokenRequest, TransferRecord,
+)
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto import sign as csign
+from fabric_token_sdk_tpu.crypto.setup import setup
+from fabric_token_sdk_tpu.drivers import identity
+from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+from fabric_token_sdk_tpu.models.token import ID
+from fabric_token_sdk_tpu.services.network import BlockPolicy, Network
+from fabric_token_sdk_tpu.utils import faults, resilience
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+rng = random.Random(0xF75)
+pp = setup(base=4, exponent=2, rng=rng)
+drv = ZKATDLogDriver(pp)
+net = Network(
+    RequestValidator(ZKATDLogDriver(pp)),
+    policy=BlockPolicy(max_block_txs=8, min_batch=2),
+)
+key = csign.keygen(rng)
+ident = identity.pk_identity(key.public)
+
+out = drv.issue(ident, "USD", [7, 7], [ident, ident], anonymous=False)
+req = TokenRequest(anchor="seed")
+req.issues.append(IssueRecord(action=out.action_bytes, issuer=ident,
+                              outputs_metadata=out.metadata,
+                              receivers=[ident, ident]))
+req.issues[0].signature = key.sign(req.marshal_to_sign(), rng)
+ev = net.submit(req.to_bytes())
+assert ev.status.value == "Valid", ev.message
+chains = [
+    (ID("seed", i), out.outputs[i], out.metadata[i]) for i in range(2)
+]
+
+def block(tag):
+    # one block of 2 same-shape (1,1) transfers -> ONE device group call
+    global chains
+    batch, nxt = [], []
+    for i, (tid, raw, meta) in enumerate(chains):
+        t = drv.transfer([tid], [raw], [meta], "USD", [7], [ident])
+        tr = TokenRequest(anchor=f"{tag}-{i}")
+        tr.transfers.append(TransferRecord(
+            action=t.action_bytes, input_ids=[tid], senders=[ident],
+            outputs_metadata=t.metadata, receivers=[ident]))
+        tr.transfers[0].signatures = [key.sign(tr.marshal_to_sign(), rng)]
+        batch.append(tr.to_bytes())
+        nxt.append((ID(f"{tag}-{i}", 0), t.outputs[0], t.metadata[0]))
+    t0 = time.monotonic()
+    events = net.submit_many(batch)
+    wall = time.monotonic() - t0
+    assert all(e.status.value == "Valid" for e in events), [
+        e.message for e in events
+    ]
+    chains = nxt
+    return wall
+
+def ctr(name):
+    return mx.REGISTRY.counter(name).value
+
+# round 0 (unbounded): pay the compile, prove the device path works
+warm_wall = block("warm")
+batched_warm = ctr("ledger.validate.batched")
+assert batched_warm >= 2, "warmup block did not ride the device plane"
+
+# rounds 1..3 under a 2s deadline: hang -> host fallback + breaker opens
+os.environ["FTS_DEVICE_DEADLINE_VERIFY_S"] = "2"
+faults.arm("batch.verify", "hang", count=1, delay_s=600)
+hang_wall = block("hung")
+faults.disarm("batch.verify")  # release the abandoned worker
+open_n = ctr("resilience.breaker.open")
+state_after_hang = resilience.breaker_states().get("verify")
+# only assert the instant-rejection behavior when the breaker is STILL
+# open as the block dispatches — on a badly loaded host the preceding
+# zk work can outlast even the 20s cooldown, making this block the
+# half-open probe instead (correct product behavior, different branch)
+rej_applicable = resilience.breaker_states().get("verify") == "open"
+rejected_wall = block("rej")   # inside cooldown: instant host fallback
+rejected_n = ctr("resilience.breaker.rejected")
+# the emulated CPU device plane legitimately needs more than 2s per
+# verify — relax the (per-dispatch, env-read) deadline so the half-open
+# probe is judged on health, not on emulation speed
+os.environ["FTS_DEVICE_DEADLINE_VERIFY_S"] = "300"
+time.sleep(20.5)               # cooldown expires -> half-open probe
+batched_before = ctr("ledger.validate.batched")
+probe_wall = block("heal")
+batched_after = ctr("ledger.validate.batched")
+print(json.dumps({
+    "ok": True,
+    "warm_wall": warm_wall,
+    "hang_wall": hang_wall,
+    "rejected_wall": rejected_wall,
+    "rej_applicable": rej_applicable,
+    "probe_wall": probe_wall,
+    "breaker_open": open_n,
+    "state_after_hang": state_after_hang,
+    "rejected": rejected_n,
+    "breaker_close": ctr("resilience.breaker.close"),
+    "timeouts": ctr("resilience.bounded.timeouts"),
+    "reengaged_rows": batched_after - batched_before,
+    "commit_p99_s": mx.REGISTRY.histogram(
+        "ledger.block.commit.seconds").quantile(0.99),
+}), flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hung_device_plane_stays_live_and_heals():
+    """Acceptance (hung-device chaos): inject a `hang` at `batch.verify`
+    mid-soak in a subprocess node. The block commits via host fallback
+    within FTS_DEVICE_DEADLINE_S + slack (never the 600s hang cap), the
+    `verify` breaker OPENS (one consecutive timeout), the next block is
+    rejected up front (instant host fallback), and after the fault
+    disarms + cooldown a half-open probe RE-ENGAGES the device plane —
+    commit p99 stays bounded throughout."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _HANG_CHILD, REPO_ROOT],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=840, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, f"chaos child failed:\n{proc.stderr[-4000:]}"
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"]
+    # bounded: the hung block resolved near the 2s deadline, nowhere
+    # near the 600s hang cap (generous slack for host zk re-validation)
+    assert report["hang_wall"] < 60, report
+    assert report["timeouts"] >= 1
+    assert report["breaker_open"] >= 1
+    assert report["state_after_hang"] == "open"
+    # open breaker = instant rejection, no deadline paid on that block
+    # (asserted only when the child saw the breaker still open at that
+    # dispatch — else the block legitimately became the probe)
+    if report["rej_applicable"]:
+        assert report["rejected"] >= 1
+    assert report["rejected_wall"] < 60, report
+    # the plane healed: probe succeeded, device verdicts flowed again
+    assert report["breaker_close"] >= 1
+    assert report["reengaged_rows"] >= 2, report
+    # and the node's overall commit p99 stayed bounded
+    assert report["commit_p99_s"] is None or report["commit_p99_s"] < 120
