@@ -706,6 +706,20 @@ def _soak(hb, zk_pp=None) -> dict:
         policy=policy,
         wal_path=wal_path,
     )
+    from fabric_token_sdk_tpu.utils import profiler, slo
+
+    # fresh SLO window for the soak (re-reads FTS_SLO_*; clears the
+    # slow-tx exemplar ring so recorded exemplars are soak txs)
+    slo.reset()
+    # host-path sampling profiler over the soak window: FTS_PROF_HZ
+    # wins when set (0 disables); otherwise the soak defaults to a
+    # modest rate so every recorded round carries a flamegraph — same
+    # precedent as the force-enabled metrics plane
+    try:
+        prof_hz = float(os.environ.get("FTS_PROF_HZ", "") or 47.0)
+    except ValueError:
+        prof_hz = 47.0
+    legs_before = profiler.leg_totals()
     rejects_before = mx.REGISTRY.counter("orderer.backpressure.rejects").value
     sign_before = {
         name: mx.REGISTRY.counter(name).value
@@ -789,6 +803,7 @@ def _soak(hb, zk_pp=None) -> dict:
                 faults.disarm(site)
 
     def client(idx):
+        profiler.set_thread_role("client")
         rng = random.Random(0xF75 + idx)
         drv = make_driver()
         key = sign.keygen(rng)
@@ -846,7 +861,9 @@ def _soak(hb, zk_pp=None) -> dict:
             errors.append(e)
 
     threads = [
-        threading.Thread(target=client, args=(i,), daemon=True)
+        # named so the sampling profiler classifies them as `client`
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"fts-soak-client-{i}")
         for i in range(clients)
     ]
     mon = threading.Thread(target=sampler, daemon=True)
@@ -863,6 +880,7 @@ def _soak(hb, zk_pp=None) -> dict:
     if chaos_deadline_set:
         os.environ["FTS_DEVICE_DEADLINE_S"] = "1"
     try:
+        profiler.start(hz=prof_hz)
         t_begin = time.monotonic()
         mon.start()
         if monkey is not None:
@@ -878,6 +896,7 @@ def _soak(hb, zk_pp=None) -> dict:
         if monkey is not None:
             monkey.join(timeout=10)
     finally:
+        prof = profiler.stop()
         if chaos_deadline_set:
             os.environ.pop("FTS_DEVICE_DEADLINE_S", None)
     if errors:
@@ -954,6 +973,35 @@ def _soak(hb, zk_pp=None) -> dict:
         "breaker_trips": resil_delta["resilience.breaker.open"],
         "degraded_planes": degraded_planes,
     }
+    # host-path profile of the window: explicit sub-leg wall clock
+    # (exclusive time, commit-path only — collected inside the block
+    # commit's profiler.collect() window) plus the sampler's collapsed
+    # stacks. Coverage = what fraction of the host_validate leg the
+    # named sub-legs explain; the remainder is uninstrumented host code.
+    legs_now = profiler.leg_totals()
+    legs_delta = {
+        name: round(legs_now.get(name, 0.0) - legs_before.get(name, 0.0), 6)
+        for name in profiler.LEGS
+    }
+    legs_sum = sum(legs_delta.values())
+    stacks = prof.collapsed() if prof is not None else {}
+    if len(stacks) > 200:
+        stacks = dict(
+            sorted(stacks.items(), key=lambda kv: -kv[1])[:200]
+        )
+    soak["profile"] = {
+        "hz": prof.hz if prof is not None else 0.0,
+        "samples": int(mx.REGISTRY.counter("prof.samples").value),
+        "host_legs": legs_delta,
+        "host_leg_coverage": (
+            round(min(1.0, legs_sum / hv_s), 4) if hv_s > 0 else None
+        ),
+        "stacks": stacks,
+        "dropped_stacks": int(mx.REGISTRY.counter("prof.dropped").value),
+    }
+    # SLO verdict over the soak window (engine was reset at soak start,
+    # so the sliding window saw only soak traffic)
+    soak["slo"] = slo.ENGINE.evaluate()
     mx.gauge("bench.soak_txs_per_s").set(soak["steady_txs_per_s"])
     if p99 is not None:
         mx.gauge("bench.soak_p99_finality_s").set(soak["p99_finality_s"])
@@ -1432,6 +1480,12 @@ def main() -> None:
         try:
             soak = _soak(hb)
             if soak:
+                # profile/slo ride inside the soak dict so direct _soak
+                # callers (tests) see them; in the recorded result they
+                # are schema-validated top-level sections of their own
+                for section in ("profile", "slo"):
+                    if section in soak:
+                        result[section] = soak.pop(section)
                 result["soak"] = soak
                 print(json.dumps(result), flush=True)
         except Exception as e:  # pragma: no cover

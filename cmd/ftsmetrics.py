@@ -261,6 +261,19 @@ def show(path: str, prometheus: bool = False) -> None:
             f" finality[p50/p95/p99]={_qs(fin_h)}"
         )
 
+    # the slow-tx exemplar ring (`slo.exemplars` meta): the K slowest
+    # submit->finality txs with their trace ids — paste one straight
+    # into `ftstrace timeline`
+    exemplars = meta.get("slo.exemplars")
+    if isinstance(exemplars, list) and exemplars:
+        print("\nslowest txs (submit->finality; trace with ftstrace timeline)")
+        for row in exemplars:
+            if not isinstance(row, (list, tuple)) or len(row) < 3:
+                continue
+            secs, tx, trace_id = row[0], row[1], row[2]
+            print(f"  {_fmt_s(float(secs)):>8}  tx={tx}"
+                  f"  trace={trace_id or '-'}")
+
     _print_kv(
         "gauges",
         sorted(d.get("gauges", {}).items()),

@@ -7,6 +7,7 @@ Usage:
     python cmd/ftstop.py compare --history BENCH_history.jsonl --scaling
     python cmd/ftstop.py compare --history BENCH_history.jsonl --soak
     python cmd/ftstop.py compare --history BENCH_history.jsonl --state
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --slo
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
@@ -122,6 +123,24 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
             "brk="
             + (",".join(f"{p}:{s}" for p, s in sorted(degraded.items()))
                if degraded else "ok")
+        )
+    # SLO column: `slo=ok` while every error budget has headroom, else
+    # the breaching SLOs with their burn (budget multiples consumed) —
+    # the "we are eating tomorrow's reliability" signal. Absent on nodes
+    # predating the SLO engine.
+    slo_sec = health.get("slo")
+    if isinstance(slo_sec, dict):
+        rows = slo_sec.get("slos", {})
+        breaching = {
+            name: r for name, r in rows.items()
+            if isinstance(r, dict) and r.get("ok") is False
+        }
+        parts.append(
+            "slo="
+            + (",".join(
+                f"{name}!{r.get('burn', 0):.1f}x"
+                for name, r in sorted(breaching.items())
+            ) if breaching else "ok")
         )
     wal = health.get("wal")
     if wal:
@@ -443,6 +462,58 @@ def compare_state(args) -> int:
     )
 
 
+def compare_slo(args) -> int:
+    """The SLO gate: unlike the regression observatories (which diff
+    against prior rounds), this is an ABSOLUTE verdict on the latest
+    history round that carries an `slo` section — the declared
+    objectives ARE the baseline. Exit 1 when any error budget is
+    exhausted (`ok: false`; CI-gateable, `--no-fail` disables), 2 when
+    no round carries the section, 0 when every budget has headroom."""
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    rows = benchschema.load_history(args.history)
+    sections = []
+    for row in rows:
+        result = benchschema.extract_result(row)
+        if not result or benchschema.validate_result(result):
+            continue
+        s = result.get("slo")
+        if isinstance(s, dict) and isinstance(s.get("slos"), dict):
+            sections.append(s)
+    if args.last:
+        sections = sections[-args.last:]
+    if not sections:
+        print(
+            "ftstop compare --slo: no history round carries an slo "
+            "section", file=sys.stderr,
+        )
+        return 2
+    latest = sections[-1]
+    print(f"== slo verdict, latest round (window {latest.get('window_s')}s)")
+    breaches = 0
+    for name, r in sorted(latest["slos"].items()):
+        if not isinstance(r, dict):
+            continue
+        ok = r.get("ok") is not False
+        if not ok:
+            breaches += 1
+        target = r.get("target_s")
+        print(
+            f"{'OK' if ok else 'BREACH':<12} {name:<16} "
+            f"objective={r.get('objective')}"
+            + (f"@{target:g}s" if _num(target) else "")
+            + f" good_frac={r.get('good_frac')}"
+            f" burn={r.get('burn')}x"
+            f" budget_remaining={r.get('budget_remaining')}"
+            f" n={r.get('total')}"
+        )
+    print(
+        f"verdict: {breaches} breached error budget(s) of "
+        f"{len(latest['slos'])}"
+    )
+    return 1 if breaches and not args.no_fail else 0
+
+
 def baseline_of(records: List[dict]) -> dict:
     """Per-metric median over a set of valid rounds — the history-mode
     baseline (one outlier round cannot poison it)."""
@@ -568,6 +639,11 @@ def main(argv=None) -> int:
                              "p99 (growth) and populate/recover throughput "
                              "(drop) vs the median of prior state-carrying "
                              "rounds (history mode only)")
+    p_gate.add_argument("--slo", action="store_true",
+                        help="gate on the latest round's SLO verdict: exit 1 "
+                             "when any error budget is exhausted — absolute, "
+                             "not relative to prior rounds (history mode "
+                             "only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
@@ -586,6 +662,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --state needs --history")
         return compare_state(args)
+    if args.slo:
+        if not args.history:
+            ap.error("compare --slo needs --history")
+        return compare_slo(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

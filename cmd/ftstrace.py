@@ -4,6 +4,7 @@ Usage:
     python cmd/ftstrace.py timeline <tx-id-or-trace-id> <sidecar.json> [...]
     python cmd/ftstrace.py export -o chrome_trace.json <sidecar.json> [...]
     python cmd/ftstrace.py tail [-n N] <flight.json>
+    python cmd/ftstrace.py flame [--role ROLE] <result-or-history.json>
 
 Inputs are any mix of ``*.metrics.json`` (span trees — what
 ``Registry.snapshot()`` flushes) and ``*.flight.json`` (flight-recorder
@@ -15,10 +16,14 @@ orderer -> batched device verify -> WAL append -> finality).
 
 `timeline` prints one trace chronologically, including the per-block
 critical-path breakdown (queue wait / grouping / device verify / host
-validate / WAL / merge) of the block that committed the tx. `export`
-writes Chrome-trace-event JSON (load in chrome://tracing or
-https://ui.perfetto.dev). `tail` prints the last N flight-recorder
-events of a crash dump — the first thing to read after an rc=124.
+validate with its named sub-legs / WAL / merge) of the block that
+committed the tx. `export` writes Chrome-trace-event JSON (load in
+chrome://tracing or https://ui.perfetto.dev). `tail` prints the last N
+flight-recorder events of a crash dump — the first thing to read after
+an rc=124. `flame` dumps the host-path sampling profile of a bench
+result (the `profile.stacks` section `bench.py` records when
+`FTS_PROF_HZ` > 0) in collapsed-stack format — pipe it straight into
+flamegraph.pl or paste into speedscope.app.
 """
 
 from __future__ import annotations
@@ -30,10 +35,13 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 # breakdown keys of a `block.commit` flight event, in pipeline order
-# (`overlap_s` only present on blocks the pipelined engine committed)
+# (`overlap_s` only present on blocks the pipelined engine committed;
+# the `host_*` sub-legs decompose `host_validate_s` by named phase)
 BLOCK_BREAKDOWN_KEYS = (
     "queue_wait_max_s", "grouping_s", "device_verify_s", "sign_verify_s",
-    "host_validate_s", "wal_s", "merge_s", "overlap_s",
+    "host_validate_s", "host_unmarshal_s", "host_fiat_shamir_s",
+    "host_sig_verify_s", "host_conservation_s", "host_input_match_s",
+    "wal_s", "merge_s", "overlap_s",
 )
 
 
@@ -253,6 +261,54 @@ def tail(path: str, n: int = 20) -> int:
     return 0
 
 
+def _profile_of(path: str) -> Optional[dict]:
+    """The `profile` section of a bench result file, or of the LATEST
+    profile-carrying round of a history jsonl."""
+    if path.endswith(".jsonl"):
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+        )
+        from fabric_token_sdk_tpu.utils import benchschema
+
+        prof = None
+        for row in benchschema.load_history(path):
+            result = benchschema.extract_result(row)
+            if result and isinstance(result.get("profile"), dict):
+                prof = result["profile"]
+        return prof
+    doc = _load(path)
+    p = doc.get("profile")
+    return p if isinstance(p, dict) else None
+
+
+def flame(path: str, role: Optional[str] = None, out=None) -> int:
+    """Print a recorded profile's collapsed stacks (`stack count` lines,
+    hottest first) — flamegraph.pl / speedscope input. Stacks are keyed
+    `role;mod:func;...`; `--role` keeps one thread role's stacks."""
+    out = out if out is not None else sys.stdout
+    prof = _profile_of(path)
+    if prof is None:
+        print(f"{path}: no profile section (run bench with FTS_PROF_HZ > 0)",
+              file=sys.stderr)
+        return 1
+    stacks = prof.get("stacks") or {}
+    if role:
+        stacks = {s: c for s, c in stacks.items()
+                  if s.split(";", 1)[0] == role}
+    if not stacks:
+        roles = sorted({s.split(";", 1)[0] for s in (prof.get("stacks") or {})})
+        print(
+            f"{path}: no stacks"
+            + (f" for role {role!r} (roles seen: {', '.join(roles) or '-'})"
+               if role else " recorded"),
+            file=sys.stderr,
+        )
+        return 1
+    for stack, count in sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0])):
+        print(f"{stack} {count}", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ftstrace", description=__doc__.splitlines()[0]
@@ -273,11 +329,21 @@ def main(argv=None) -> int:
     )
     p_ta.add_argument("-n", type=int, default=20)
     p_ta.add_argument("flight")
+    p_fl = sub.add_parser(
+        "flame", help="dump a recorded host-path profile as collapsed stacks"
+    )
+    p_fl.add_argument("--role", default=None,
+                      help="keep one thread role (commit-worker, "
+                           "stage-a-driver, remote-handler, client, other)")
+    p_fl.add_argument("result",
+                      help="bench result JSON or BENCH_history.jsonl")
     args = ap.parse_args(argv)
     if args.cmd == "timeline":
         return timeline(args.ident, args.sidecars)
     if args.cmd == "export":
         return export(args.out, args.sidecars)
+    if args.cmd == "flame":
+        return flame(args.result, args.role)
     return tail(args.flight, args.n)
 
 

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 from ..crypto.serialization import dumps, loads
 from ..models.token import ID
+from ..utils import profiler
 
 
 @dataclass
@@ -68,17 +69,19 @@ class TokenRequest:
 
     def marshal_to_sign(self) -> bytes:
         """Byte string signed by owners/issuers (reference request.go:655)."""
-        return dumps(self._actions_dict())
+        with profiler.leg("unmarshal"):
+            return dumps(self._actions_dict())
 
     def marshal_to_audit(self) -> bytes:
         """Byte string signed by the auditor (reference request.go:643):
         actions + metadata binding."""
-        d = self._actions_dict()
-        d["meta"] = {
-            "issues": [r.outputs_metadata for r in self.issues],
-            "transfers": [r.outputs_metadata for r in self.transfers],
-        }
-        return dumps(d)
+        with profiler.leg("unmarshal"):
+            d = self._actions_dict()
+            d["meta"] = {
+                "issues": [r.outputs_metadata for r in self.issues],
+                "transfers": [r.outputs_metadata for r in self.transfers],
+            }
+            return dumps(d)
 
     def to_bytes(self) -> bytes:
         return dumps(
@@ -112,6 +115,11 @@ class TokenRequest:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "TokenRequest":
+        with profiler.leg("unmarshal"):
+            return cls._from_bytes_inner(raw)
+
+    @classmethod
+    def _from_bytes_inner(cls, raw: bytes) -> "TokenRequest":
         d = loads(raw)
         req = cls(anchor=d["anchor"])
         for r in d["issues"]:

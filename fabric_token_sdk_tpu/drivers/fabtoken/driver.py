@@ -15,6 +15,7 @@ from ...api.driver import Driver, IssueOutcome, TransferOutcome, ValidationError
 from ...crypto.serialization import dumps, loads
 from ...models.quantity import Quantity
 from ...models.token import ID, Owner, Token, UnspentToken
+from ...utils import profiler
 from .. import identity
 
 MAX_PRECISION = 64
@@ -109,23 +110,24 @@ class FabTokenDriver(Driver):
 
     @vguard
     def validate_issue(self, action_bytes: bytes):
-        d = loads(action_bytes)
-        outputs = d["outputs"]
-        if not outputs:
-            raise ValidationError("issue must have at least one output")
-        issuer = d["issuer"]
-        if self.pp.issuers and issuer not in self.pp.issuers:
-            raise ValidationError("issuer is not authorized")
-        token_type = None
-        for raw in outputs:
-            t = Token.from_bytes(raw)
-            q = t.quantity_as(self.pp.quantity_precision)
-            if q.is_zero():
-                raise ValidationError("issue output with zero value")
-            if token_type is None:
-                token_type = t.type
-            elif t.type != token_type:
-                raise ValidationError("issue outputs with mixed types")
+        with profiler.leg("conservation"):
+            d = loads(action_bytes)
+            outputs = d["outputs"]
+            if not outputs:
+                raise ValidationError("issue must have at least one output")
+            issuer = d["issuer"]
+            if self.pp.issuers and issuer not in self.pp.issuers:
+                raise ValidationError("issuer is not authorized")
+            token_type = None
+            for raw in outputs:
+                t = Token.from_bytes(raw)
+                q = t.quantity_as(self.pp.quantity_precision)
+                if q.is_zero():
+                    raise ValidationError("issue output with zero value")
+                if token_type is None:
+                    token_type = t.type
+                elif t.type != token_type:
+                    raise ValidationError("issue outputs with mixed types")
         # fabtoken issues always require the action issuer's signature
         return outputs, issuer
 
@@ -135,26 +137,33 @@ class FabTokenDriver(Driver):
                           sig_verified=None):
         # fabtoken carries no ZK proof: `transfer_batch_plan` never emits
         # a plan, so `proof_verified` is always None here and ignored
-        d = loads(action_bytes)
-        ids = [ID(t, i) for t, i in d["ids"]]
-        if not ids:
-            raise ValidationError("transfer must have at least one input")
-        ledger_inputs = [resolve_input(i) for i in ids]
-        inputs = [Token.from_bytes(raw) for raw in ledger_inputs]
-        outputs = [Token.from_bytes(raw) for raw in d["outputs"]]
-        # action must reference the same inputs it was signed over
-        if d["inputs"] != ledger_inputs:
-            raise ValidationError("transfer inputs do not match ledger state")
-        types = {t.type for t in inputs} | {t.type for t in outputs}
-        if len(types) != 1:
-            raise ValidationError(f"tokens must have the same type, got {sorted(types)}")
-        p = self.pp.quantity_precision
-        in_sum = sum(t.quantity_as(p).value for t in inputs)
-        out_sum = sum(t.quantity_as(p).value for t in outputs)
-        if in_sum != out_sum:
-            raise ValidationError(
-                f"transfer does not preserve value: in={in_sum} out={out_sum}"
-            )
+        with profiler.leg("input_match"):
+            d = loads(action_bytes)
+            ids = [ID(t, i) for t, i in d["ids"]]
+            if not ids:
+                raise ValidationError("transfer must have at least one input")
+            ledger_inputs = [resolve_input(i) for i in ids]
+            # action must reference the same inputs it was signed over
+            if d["inputs"] != ledger_inputs:
+                raise ValidationError(
+                    "transfer inputs do not match ledger state"
+                )
+        with profiler.leg("conservation"):
+            inputs = [Token.from_bytes(raw) for raw in ledger_inputs]
+            outputs = [Token.from_bytes(raw) for raw in d["outputs"]]
+            types = {t.type for t in inputs} | {t.type for t in outputs}
+            if len(types) != 1:
+                raise ValidationError(
+                    f"tokens must have the same type, got {sorted(types)}"
+                )
+            p = self.pp.quantity_precision
+            in_sum = sum(t.quantity_as(p).value for t in inputs)
+            out_sum = sum(t.quantity_as(p).value for t in outputs)
+            if in_sum != out_sum:
+                raise ValidationError(
+                    f"transfer does not preserve value: "
+                    f"in={in_sum} out={out_sum}"
+                )
         if len(signatures) != len(inputs):
             raise ValidationError("one signature per input owner required")
         for si, (t, sig) in enumerate(zip(inputs, signatures)):

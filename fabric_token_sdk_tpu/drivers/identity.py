@@ -29,6 +29,7 @@ from typing import Optional, Tuple
 from ..crypto import hostmath as hm, nym as nym_mod, sign
 from ..crypto.serialization import dumps, loads
 from ..utils import metrics as mx
+from ..utils import profiler
 
 
 def pk_identity(public: sign.PublicKey) -> bytes:
@@ -148,18 +149,24 @@ def public_key(raw: bytes) -> Optional[sign.PublicKey]:
 def verify_signature(identity: bytes, message: bytes, signature: bytes,
                      nym_params=None, now=None) -> None:
     """Dispatch signature verification on the identity kind."""
-    kind, pk, d = _CACHE.lookup(identity)
-    if kind == "pk":
-        pk.verify(message, signature)
-    elif kind == "nym":
-        if nym_params is None:
-            raise ValueError("nym verification requires nym parameters")
-        nym_mod.NymVerifier(d["nym"], list(nym_params)).verify(message, signature)
-    elif kind == "htlc":
-        # hash-time-locked script: claim/reclaim rules (lazy import to
-        # avoid a services <-> drivers cycle)
-        from ..services.interop.htlc import verify_htlc_spend
+    with profiler.leg("sig_verify"):
+        kind, pk, d = _CACHE.lookup(identity)
+        if kind == "pk":
+            pk.verify(message, signature)
+        elif kind == "nym":
+            if nym_params is None:
+                raise ValueError("nym verification requires nym parameters")
+            nym_mod.NymVerifier(d["nym"], list(nym_params)).verify(
+                message, signature
+            )
+        elif kind == "htlc":
+            # hash-time-locked script: claim/reclaim rules (lazy import
+            # to avoid a services <-> drivers cycle)
+            from ..services.interop.htlc import verify_htlc_spend
 
-        verify_htlc_spend(identity, message, signature, nym_params, now=now)
-    else:
-        raise ValueError(f"cannot verify signature for identity kind [{kind}]")
+            verify_htlc_spend(identity, message, signature, nym_params,
+                              now=now)
+        else:
+            raise ValueError(
+                f"cannot verify signature for identity kind [{kind}]"
+            )

@@ -17,6 +17,7 @@ from ...crypto.serialization import dumps, loads
 from ...crypto.setup import PublicParams
 from ...crypto.token import Metadata, Token as ZkToken, TokenDataWitness, token_in_the_clear, tokens_with_witness
 from ...models.token import ID, Owner, UnspentToken
+from ...utils import profiler
 from .. import identity
 
 
@@ -157,9 +158,10 @@ class ZKATDLogDriver(Driver):
         elif issuer:
             raise ValidationError("anonymous issue must not name an issuer")
         try:
-            issue_mod.IssueVerifier(
-                [t.data for t in outputs], anonymous, self.pp
-            ).verify(d["proof"])
+            with profiler.leg("fiat_shamir"):
+                issue_mod.IssueVerifier(
+                    [t.data for t in outputs], anonymous, self.pp
+                ).verify(d["proof"])
         except ValueError as e:
             raise ValidationError(f"invalid issue proof: {e}") from e
         # non-anonymous issues require the named issuer's signature
@@ -169,15 +171,19 @@ class ZKATDLogDriver(Driver):
     def validate_transfer(self, action_bytes, resolve_input, signed_payload,
                           signatures, now=None, proof_verified=None,
                           sig_verified=None):
-        d = loads(action_bytes)
-        ids = [ID(t, i) for t, i in d["ids"]]
-        if not ids:
-            raise ValidationError("transfer must have at least one input")
-        ledger_inputs = [resolve_input(i) for i in ids]
-        if d["inputs"] != ledger_inputs:
-            raise ValidationError("transfer inputs do not match ledger state")
-        in_tokens = [ZkToken.from_bytes(raw) for raw in ledger_inputs]
-        out_tokens = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+        with profiler.leg("input_match"):
+            d = loads(action_bytes)
+            ids = [ID(t, i) for t, i in d["ids"]]
+            if not ids:
+                raise ValidationError("transfer must have at least one input")
+            ledger_inputs = [resolve_input(i) for i in ids]
+            if d["inputs"] != ledger_inputs:
+                raise ValidationError(
+                    "transfer inputs do not match ledger state"
+                )
+        with profiler.leg("conservation"):
+            in_tokens = [ZkToken.from_bytes(raw) for raw in ledger_inputs]
+            out_tokens = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
         if proof_verified is False:
             raise ValidationError("invalid transfer proof")
         if proof_verified is None:
@@ -186,10 +192,12 @@ class ZKATDLogDriver(Driver):
             # this action carries (and the inputs==ledger check above
             # pins the claimed statement to ledger state)
             try:
-                transfer_mod.TransferVerifier(
-                    [t.data for t in in_tokens], [t.data for t in out_tokens],
-                    self.pp,
-                ).verify(d["proof"])
+                with profiler.leg("fiat_shamir"):
+                    transfer_mod.TransferVerifier(
+                        [t.data for t in in_tokens],
+                        [t.data for t in out_tokens],
+                        self.pp,
+                    ).verify(d["proof"])
             except ValueError as e:
                 raise ValidationError(f"invalid transfer proof: {e}") from e
         if len(signatures) != len(in_tokens):

@@ -33,7 +33,7 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator, ValidationResult
 from ...models.token import ID
-from ...utils import faults, resilience
+from ...utils import faults, profiler, resilience, slo
 from ...utils import metrics as mx
 from ...utils.tracing import logger, tracer
 from .orderer import (
@@ -231,6 +231,9 @@ class Network:
             # entry is the live signal a device plane is degraded and
             # riding its host fallback (ftstop renders the brk column)
             "breakers": resilience.breaker_states(),
+            # live error-budget state (utils/slo.py): per-SLO burn over
+            # the sliding window — the `slo=` column of `ftstop top`
+            "slo": slo.ENGINE.health_section(),
         }
 
     # ------------------------------------------------------------ ordering
@@ -458,17 +461,21 @@ class Network:
             view = _BlockView(self._state, self._spent)
             events: List[FinalityEvent] = []
             t0 = time.monotonic()
-            for ti, request in enumerate(requests):
-                # per-tx validation runs under the TX's trace, not the
-                # committing thread's — whoever wins the commit race
-                with mx.use_trace(fresh[ti].trace):
-                    event = self._validate_tx(
-                        request, view, commit_time, verdicts.get(ti),
-                        sig_verdicts.get(ti),
-                    )
-                if fresh[ti].trace is not None:
-                    event.trace_id = fresh[ti].trace.trace_id
-                events.append(event)
+            # sub-leg attribution of the host tail: the per-tx loop runs
+            # on this one thread, so a thread-local collector decomposes
+            # host_validate_s into the named `ledger.host.*` legs
+            with profiler.collect() as host_legs:
+                for ti, request in enumerate(requests):
+                    # per-tx validation runs under the TX's trace, not
+                    # the committing thread's — whoever wins the race
+                    with mx.use_trace(fresh[ti].trace):
+                        event = self._validate_tx(
+                            request, view, commit_time, verdicts.get(ti),
+                            sig_verdicts.get(ti),
+                        )
+                    if fresh[ti].trace is not None:
+                        event.trace_id = fresh[ti].trace.trace_id
+                    events.append(event)
             host_validate_s = time.monotonic() - t0
             faults.fire("ledger.commit_block")
             # WAL append BEFORE the atomic merge: once the record is
@@ -514,6 +521,12 @@ class Network:
                 "wal_s": round(wal_s, 6),
                 "merge_s": round(merge_s, 6),
             }
+            # the host leg decomposed (utils/profiler.py sub-leg timers):
+            # exclusive per-leg seconds of THIS block's host-validate loop
+            for leg_name in profiler.LEGS:
+                breakdown[f"host_{leg_name}_s"] = round(
+                    host_legs.get(leg_name, 0.0), 6
+                )
             if pre is not None:
                 # pipelined engine: how much of THIS block's device
                 # verify ran while the previous block's commit stage was
@@ -543,6 +556,9 @@ class Network:
                 traces=[s.trace.trace_id if s.trace else None for s in fresh],
                 **breakdown,
             )
+        # error-budget bookkeeping (throttled internally): breaches must
+        # surface during load even when nothing polls `ops.health`
+        slo.ENGINE.tick()
         # snapshot compaction: still under the orderer's commit lock (the
         # only WAL writer), outside the ledger lock (snapshot() retakes
         # it). The block is already durable in the journal by now, so a

@@ -47,7 +47,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ...api.request import TokenRequest
 from ...api.validator import SIG_AUDITOR, RequestValidator
 from ...drivers import identity
-from ...utils import faults, resilience
+from ...utils import faults, resilience, slo
 from ...utils import metrics as mx
 from ...utils.tracing import logger
 
@@ -152,8 +152,16 @@ class Submission:
             # live in-flight accounting + the submit→finality latency
             # histogram (always on: the ops plane reads its quantiles)
             self._orderer._mark_resolved()
+            finality_s = max(0.0, time.monotonic() - self.enqueued_at)
             mx.histogram("network.submit_to_finality.seconds").observe(
-                max(0.0, time.monotonic() - self.enqueued_at)
+                finality_s
+            )
+            # slow-tx exemplar ring (utils/slo.py): the K slowest txs
+            # keep their trace ids so `ftstrace timeline` has a concrete
+            # target after a soak
+            slo.record_exemplar(
+                finality_s, event.tx_id,
+                self.trace.trace_id if self.trace else None,
             )
         mx.flight(
             "finality", trace=self.trace,

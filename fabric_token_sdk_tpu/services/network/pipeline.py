@@ -67,6 +67,7 @@ import time
 from typing import Callable, List, Optional
 
 from ...utils import metrics as mx
+from ...utils import profiler
 from ...utils.tracing import logger
 
 
@@ -183,6 +184,9 @@ class PipelinedBlockEngine:
     # ------------------------------------------------------------ stage B
 
     def _run(self) -> None:
+        # profile role of this thread: every stage-B sample collapses
+        # under `commit-worker` in the flamegraph export
+        profiler.set_thread_role("commit-worker")
         while True:
             subs, pre = self._q.get()
             self._commit_clock.start()

@@ -59,7 +59,7 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator
 from ...models.token import ID
-from ...utils import faults
+from ...utils import faults, profiler
 from ...utils import metrics as mx
 from ...utils.tracing import logger
 from .ledger import FinalityEvent, Network, TxStatus
@@ -140,6 +140,9 @@ class LedgerServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # profile role: connection threads collapse under
+                # `remote-handler` in the flamegraph export
+                profiler.set_thread_role("remote-handler")
                 with outer._conns_lock:
                     outer._conns.add(self.request)
                 try:

@@ -66,6 +66,8 @@ OPTIONAL = {
     "scaling": list,  # throughput-vs-devices curve (validated per row)
     "soak": dict,  # sustained-load soak section (validated per field)
     "state": dict,  # state-plane scale section (validated per field)
+    "profile": dict,  # host-path profiler section (validated per field)
+    "slo": dict,  # error-budget section (validated per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
 
@@ -166,6 +168,79 @@ def validate_state(state) -> List[str]:
     return problems
 
 
+# the host-path profiler section (`profile` field, recorded by the soak
+# phase): sampling rate actually used (0 = sampler off), how many
+# sampling passes ran, the per-leg seconds the sub-leg timers collected
+# over the soak window ({leg: seconds}), what fraction of the host-leg
+# wall time those named legs explain (null when no host leg ran), and
+# the bounded collapsed-stack table ({"role;frame;frame": samples}) the
+# `ftstrace flame` subcommand renders
+PROFILE_REQUIRED = {
+    "hz": _NUM,
+    "samples": int,
+    "host_legs": dict,
+    "stacks": dict,
+}
+
+PROFILE_OPTIONAL = {
+    "host_leg_coverage": _NULLABLE_NUM,
+    "dropped_stacks": int,
+}
+
+
+def validate_profile(profile) -> List[str]:
+    """Schema problems of one `profile` section (empty list = valid)."""
+    if not isinstance(profile, dict):
+        return [f"profile is {type(profile).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, profile, PROFILE_REQUIRED, required=True)
+    _check(problems, profile, PROFILE_OPTIONAL, required=False)
+    legs = profile.get("host_legs")
+    if isinstance(legs, dict):
+        for k, v in legs.items():
+            if isinstance(v, bool) or not isinstance(v, _NUM) or v < 0:
+                problems.append(f"profile.host_legs[{k!r}] not a number >= 0")
+    stacks = profile.get("stacks")
+    if isinstance(stacks, dict):
+        for k, v in stacks.items():
+            if isinstance(v, bool) or not isinstance(v, int) or v <= 0:
+                problems.append(f"profile.stacks[{k!r}] not a count > 0")
+    cov = profile.get("host_leg_coverage")
+    if isinstance(cov, _NUM) and not isinstance(cov, bool) and cov < 0:
+        problems.append("profile.host_leg_coverage is negative")
+    return problems
+
+
+# the error-budget section (`slo` field, recorded by the soak phase and
+# gated absolutely by `ftstop compare --slo`): the sliding window the
+# engine evaluated over, and one row per SLO with its objective, burn
+# rate ((1 - good_frac) / (1 - objective); >= 1 means the budget is
+# exhausted), remaining budget fraction and verdict
+SLO_ROW_REQUIRED = {
+    "objective": _NUM,
+    "burn": _NUM,
+    "budget_remaining": _NUM,
+    "total": int,
+    "ok": bool,
+}
+
+
+def validate_slo(slo) -> List[str]:
+    """Schema problems of one `slo` section (empty list = valid)."""
+    if not isinstance(slo, dict):
+        return [f"slo is {type(slo).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, slo, {"window_s": _NUM, "slos": dict}, required=True)
+    for name, row in (slo.get("slos") or {}).items():
+        if not isinstance(row, dict):
+            problems.append(f"slo.slos[{name!r}] is {type(row).__name__}")
+            continue
+        rp: List[str] = []
+        _check(rp, row, SLO_ROW_REQUIRED, required=True)
+        problems.extend(f"slo.slos[{name!r}]: {p}" for p in rp)
+    return problems
+
+
 # one row of the throughput-vs-devices scaling curve (`scaling` field):
 # `n_devices` is the dp x mp mesh extent the block phase ran under,
 # `block_txs_per_s` its measured rate, `efficiency` the per-device
@@ -254,6 +329,10 @@ def validate_result(result) -> List[str]:
         problems.extend(validate_soak(result["soak"]))
     if isinstance(result.get("state"), dict):
         problems.extend(validate_state(result["state"]))
+    if isinstance(result.get("profile"), dict):
+        problems.extend(validate_profile(result["profile"]))
+    if isinstance(result.get("slo"), dict):
+        problems.extend(validate_slo(result["slo"]))
     return problems
 
 

@@ -188,10 +188,12 @@ def load_env(spec: Optional[str] = None) -> int:
         if len(fields) < 2:
             raise ValueError(f"bad FTS_FAULTS entry {part!r}")
         site, kind = fields[0], fields[1]
-        prob = float(fields[2]) if len(fields) > 2 else 1.0
-        count = int(fields[3]) if len(fields) > 3 else None
+        # an empty field keeps its default, so "site:delay:1.0::1.5"
+        # reads as prob=1.0, unlimited count, delay_s=1.5
+        prob = float(fields[2]) if len(fields) > 2 and fields[2] else 1.0
+        count = int(fields[3]) if len(fields) > 3 and fields[3] else None
         # None lets arm() pick the per-kind default (hang: HANG_CAP_S)
-        delay_s = float(fields[4]) if len(fields) > 4 else None
+        delay_s = float(fields[4]) if len(fields) > 4 and fields[4] else None
         arm(site, kind, prob=prob, count=count, delay_s=delay_s)
         n += 1
     return n

@@ -194,6 +194,38 @@ class Histogram:
             total, lo, hi = self._count, self._min, self._max
         return self._interp(q, self.buckets, counts, total, lo, hi)
 
+    def state(self) -> tuple:
+        """`(counts, count, sum)` — a consistent copy of the cumulative
+        internal state, the primitive sliding-window consumers (the SLO
+        engine) DIFF between two instants. Read-only: windowing lives
+        entirely in the consumer's ring of these copies, so the
+        cumulative `snapshot()`/`to_prometheus()` semantics are
+        untouched by construction."""
+        with self._lock:
+            return list(self._counts), self._count, self._sum
+
+    @staticmethod
+    def fraction_le(buckets, counts, threshold: float) -> Optional[float]:
+        """Fraction of observations <= `threshold` given per-bucket
+        counts (typically a window DELTA of two `state()` copies),
+        interpolating linearly inside the bucket the threshold falls in
+        (Prometheus `histogram_quantile` style, inverted). None when the
+        counts are empty — no data is not the same as all-good."""
+        total = sum(counts)
+        if total <= 0:
+            return None
+        good = 0.0
+        prev = 0.0
+        for b, c in zip(buckets, counts):
+            if threshold >= b:
+                good += c
+                prev = b
+                continue
+            if threshold > prev and c:
+                good += c * (threshold - prev) / (b - prev)
+            break
+        return min(1.0, good / total)
+
     def snapshot(self) -> dict:
         # timed acquire: may run under a signal handler (see Registry)
         acquired = self._lock.acquire(timeout=1.0)

@@ -1,0 +1,334 @@
+"""Host-path profiler: sub-leg timers + a sampling wall-clock profiler.
+
+The soak plateaued with `host_validate_frac` ≈ 0.16 while the other ~84%
+of each commit was per-tx Python the telemetry plane could not attribute
+— `ledger.block.host_validate.seconds` was one opaque leg. This module
+is the attribution layer, two instruments sharing one goal (find the
+guilty milliseconds inside the per-tx host tail):
+
+**Sub-leg timers** (`leg(name)`): explicit, always-on decomposition of
+`_commit_block_inner`'s host-validate loop into named histograms
+`ledger.host.<name>.seconds` for the legs
+
+    unmarshal      request decode + canonical re-marshalling
+                   (`TokenRequest.from_bytes`, `marshal_to_sign/audit`)
+    fiat_shamir    host zk proof verification (zkatdlog transfer/issue
+                   verifiers — the non-interactive challenge re-derivation)
+    sig_verify     host signature checks (`identity.verify_signature`:
+                   Schnorr pk, nym, htlc dispatch)
+    conservation   fabtoken token parse + type/sum conservation checks
+    input_match    input id decode, ledger resolve, action/record
+                   consistency checks
+
+Legs are attributed EXCLUSIVELY: a `leg` nested inside another bills the
+inner leg only (the outer leg's self-time excludes it), so the sum of
+legs never double-counts. Timing runs ONLY while a collector is active
+(`collect()`, entered by the ledger around the host-validate loop);
+everywhere else — client-side marshalling, wallet flows — `leg()` is a
+zero-cost passthrough (one thread-local lookup), so the
+`ledger.host.*` histograms see commit-path samples exclusively and the
+off-path overhead is nil. Collected per-leg seconds ride the block's
+critical-path breakdown (`host_<leg>_s`), the `block.commit` flight
+event, and `ops.health`'s last-block line; cumulative totals
+(`leg_totals()`) let bench compute what fraction of the host leg the
+named sub-legs explain.
+
+**Sampling profiler** (`SamplingProfiler`): a daemon thread walking
+`sys._current_frames()` at `FTS_PROF_HZ` (default 0 = off — zero
+threads, zero overhead), aggregating collapsed stacks per thread ROLE:
+
+    commit-worker   the pipelined engine's stage-B thread
+    stage-a-driver  whoever is driving cut + device verify
+    remote-handler  per-connection server threads (remote.py)
+    client          soak/bench submitter threads
+    other           everything else (main thread included)
+
+Roles resolve from an explicit registration (`set_thread_role`), then
+the thread name, then a stack heuristic. The stack table is bounded by
+`FTS_PROF_MAX_STACKS` (new stacks beyond the cap are dropped and
+counted under `prof.dropped` — sampling must never grow unbounded).
+`collapsed()` returns flamegraph-ready collapsed text
+(`role;frame;frame count`), exported by `ftstrace flame` and the
+`profile` section of the soak result JSON. Observability of the
+observer: `prof.samples` counts sampling passes, `prof.stacks` gauges
+the live table size.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from . import metrics as mx
+
+# the named sub-legs of the commit host-validate loop (breakdown key
+# order — `host_<leg>_s` in the block critical-path breakdown)
+LEGS = ("unmarshal", "fiat_shamir", "sig_verify", "conservation",
+        "input_match")
+
+_tl = threading.local()
+
+# cumulative per-leg seconds collected across every `collect()` window
+# of the process — the denominator-free totals bench diffs around a soak
+# to compute host-leg coverage
+_totals: Dict[str, float] = {}
+_totals_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def leg(name: str):
+    """Time one named sub-leg of the host-validate path.
+
+    Active only under a `collect()` window on the same thread (the
+    ledger's host-validate loop); anywhere else this is a zero-cost
+    passthrough. Nested legs bill exclusively: the outer leg's recorded
+    time excludes the inner leg's wall time."""
+    stack = getattr(_tl, "stack", None)
+    if stack is None:
+        yield
+        return
+    frame = [name, time.monotonic(), 0.0]  # [name, t0, child_wall_s]
+    stack.append(frame)
+    try:
+        yield
+    finally:
+        now = time.monotonic()
+        stack.pop()
+        wall = now - frame[1]
+        self_s = max(0.0, wall - frame[2])
+        if stack:
+            stack[-1][2] += wall  # parent excludes this leg's wall time
+        mx.histogram(f"ledger.host.{name}.seconds").observe(self_s)
+        col = _tl.collector
+        col[name] = col.get(name, 0.0) + self_s
+        with _totals_lock:
+            _totals[name] = _totals.get(name, 0.0) + self_s
+
+
+@contextlib.contextmanager
+def collect():
+    """Activate sub-leg collection on this thread; yields the dict the
+    window's per-leg seconds accumulate into ({leg: seconds}). Entered
+    by `_commit_block_inner` around the per-tx host-validate loop (a
+    single-threaded loop, so thread-local state is exact)."""
+    prev_stack = getattr(_tl, "stack", None)
+    prev_col = getattr(_tl, "collector", None)
+    out: Dict[str, float] = {}
+    _tl.stack = []
+    _tl.collector = out
+    try:
+        yield out
+    finally:
+        _tl.stack = prev_stack
+        _tl.collector = prev_col
+
+
+def leg_totals() -> Dict[str, float]:
+    """Cumulative per-leg seconds collected so far (process lifetime,
+    collector windows only) — diff two copies around a measured window."""
+    with _totals_lock:
+        return dict(_totals)
+
+
+# ------------------------------------------------------------ thread roles
+
+_roles: Dict[int, str] = {}
+_roles_lock = threading.Lock()
+
+# thread-name prefixes -> role (the commit worker and bench clients are
+# named at spawn; registration beats this map when both apply)
+_NAME_ROLES = (
+    ("fts-block-commit", "commit-worker"),
+    ("fts-soak-client", "client"),
+)
+
+# sampler-internal threads that must never appear in their own profile
+_SKIP_NAMES = ("fts-prof", "fts-heartbeat")
+
+
+def set_thread_role(role: str) -> None:
+    """Register the CALLING thread's profile role (commit worker, remote
+    handler, client). Bounded implicitly: one entry per live thread id,
+    overwritten on reuse."""
+    with _roles_lock:
+        _roles[threading.get_ident()] = role
+
+
+def _classify(ident: int, name: str, frames) -> str:
+    with _roles_lock:
+        role = _roles.get(ident)
+    if role:
+        return role
+    for prefix, r in _NAME_ROLES:
+        if name.startswith(prefix):
+            return r
+    for filename, func in frames:
+        if filename.endswith("remote.py"):
+            return "remote-handler"
+        if filename.endswith("pipeline.py") and func == "submit":
+            return "stage-a-driver"
+        if filename.endswith("orderer.py") and func in ("drive", "flush"):
+            return "stage-a-driver"
+    return "other"
+
+
+# ------------------------------------------------------------ sampler
+
+
+class SamplingProfiler:
+    """Wall-clock sampling profiler over `sys._current_frames()`.
+
+    `hz` <= 0 means OFF: `start()` spawns nothing and the process runs
+    with zero profiler threads (the zero-cost-when-off contract the
+    tests pin). The stack table is bounded at `max_stacks` distinct
+    collapsed stacks; beyond the cap new stacks are dropped (counted,
+    never grown) so a pathological workload cannot balloon memory."""
+
+    def __init__(self, hz: Optional[float] = None,
+                 max_stacks: Optional[int] = None,
+                 max_depth: int = 48):
+        if hz is None:
+            try:
+                hz = float(os.environ.get("FTS_PROF_HZ", "0"))
+            except ValueError:
+                hz = 0.0
+        if max_stacks is None:
+            try:
+                max_stacks = int(os.environ.get("FTS_PROF_MAX_STACKS", "2000"))
+            except ValueError:
+                max_stacks = 2000
+        self.hz = hz
+        self.max_stacks = max(1, max_stacks)
+        self.max_depth = max(1, max_depth)
+        self.samples = 0
+        self.dropped = 0
+        self._stacks: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "SamplingProfiler":
+        if self.hz <= 0 or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fts-prof", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> "SamplingProfiler":
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+        return self
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                self.sample()
+            except Exception:
+                # the observer must never take the process down
+                mx.counter("prof.errors").inc()
+
+    # -- sampling -----------------------------------------------------
+
+    def sample(self) -> None:
+        """One sampling pass over every live thread (public so tests can
+        drive it deterministically without the daemon thread)."""
+        me = threading.get_ident()
+        names = {t.ident: t.name or "" for t in threading.enumerate()}
+        for ident, top in sys._current_frames().items():
+            if ident == me:
+                continue
+            name = names.get(ident, "")
+            if name.startswith(_SKIP_NAMES):
+                continue
+            frames = []
+            f = top
+            while f is not None and len(frames) < self.max_depth:
+                code = f.f_code
+                frames.append((code.co_filename, code.co_name))
+                f = f.f_back
+            frames.reverse()  # root first, flamegraph order
+            role = _classify(ident, name, frames)
+            key = role + ";" + ";".join(
+                "%s:%s" % (os.path.basename(fn).rsplit(".", 1)[0], func)
+                for fn, func in frames
+            )
+            with self._lock:
+                if key in self._stacks:
+                    self._stacks[key] += 1
+                elif len(self._stacks) < self.max_stacks:
+                    self._stacks[key] = 1
+                else:
+                    self.dropped += 1
+                    mx.counter("prof.dropped").inc()
+        self.samples += 1
+        mx.counter("prof.samples").inc()
+        mx.gauge("prof.stacks").set(len(self._stacks))
+
+    # -- export -------------------------------------------------------
+
+    def collapsed(self, role: Optional[str] = None) -> Dict[str, int]:
+        """{collapsed stack: sample count}; `role` filters to one thread
+        role. Keys are `role;frame;frame` with root-first frames —
+        `"\\n".join(f"{k} {v}")` is flamegraph.pl input."""
+        with self._lock:
+            items = dict(self._stacks)
+        if role is not None:
+            prefix = role + ";"
+            items = {k: v for k, v in items.items() if k.startswith(prefix)}
+        return items
+
+    def stack_count(self) -> int:
+        with self._lock:
+            return len(self._stacks)
+
+
+# process-wide sampler managed by bench (started around the soak window)
+_active: Optional[SamplingProfiler] = None
+_active_lock = threading.Lock()
+
+
+def start(hz: Optional[float] = None,
+          max_stacks: Optional[int] = None) -> Optional[SamplingProfiler]:
+    """Start the process-wide sampler (idempotent). Returns None when
+    the resolved rate is <= 0 — off means zero threads."""
+    global _active
+    with _active_lock:
+        if _active is not None and _active.running():
+            return _active
+        p = SamplingProfiler(hz=hz, max_stacks=max_stacks)
+        if p.hz <= 0:
+            return None
+        _active = p.start()
+        return _active
+
+
+def stop() -> Optional[SamplingProfiler]:
+    """Stop the process-wide sampler; returns it (with its samples) or
+    None if never started."""
+    global _active
+    with _active_lock:
+        p = _active
+        _active = None
+    if p is not None:
+        p.stop()
+    return p
+
+
+def active() -> Optional[SamplingProfiler]:
+    return _active

@@ -160,6 +160,61 @@ def test_every_emitted_metric_is_documented():
     )
 
 
+def test_profiler_and_slo_names_pinned_both_ways():
+    """The observability-PR names cannot drift in either direction: the
+    host sub-leg histograms, the sampler counters, the SLO gauges and
+    the `slo.breach` flight kind must be emitted by the code AND
+    documented; the `FTS_PROF_*`/`FTS_SLO_*` env knobs referenced by the
+    code must appear in the doc's switches table and vice versa."""
+    from fabric_token_sdk_tpu.utils import profiler
+
+    emitted, corpus = _emitted()
+    emitted_names = {name for _kind, name in emitted}
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    exact, prefixes = _doc_names(doc)
+
+    # sub-leg histograms: emitted as the f-string prefix `ledger.host.`,
+    # documented as the five concrete `ledger.host.<leg>.seconds` names
+    assert ("histogram", "ledger.host.") in emitted
+    assert set(profiler.LEGS) == {
+        "unmarshal", "fiat_shamir", "sig_verify", "conservation",
+        "input_match",
+    }
+    for leg in profiler.LEGS:
+        assert f"ledger.host.{leg}.seconds" in exact, leg
+
+    # sampler + SLO instruments, both ways
+    for name in ("prof.samples", "prof.dropped", "prof.errors",
+                 "prof.stacks", "slo.breaches"):
+        assert name in emitted_names, f"{name} no longer emitted"
+        assert name in exact, f"{name} undocumented"
+    for prefix in ("slo.burn.", "slo.budget."):
+        assert prefix in emitted_names, f"{prefix}* no longer emitted"
+        assert prefix in prefixes, f"{prefix}* undocumented"
+
+    # the breach flight kind rides the taxonomy table
+    assert ("flight", "slo.breach") in emitted
+    assert "slo.breach" in _doc_flight_kinds(doc)
+
+    # exemplar meta key: published by the engine, named in the doc
+    assert '"slo.exemplars"' in corpus
+    assert "`slo.exemplars`" in doc
+
+    # env knobs both ways: every FTS_PROF_*/FTS_SLO_* the code reads is
+    # in the switches table, and the table names no dead knobs
+    code_knobs = set(re.findall(r'"(FTS_(?:PROF|SLO)_[A-Z0-9_]+)"', corpus))
+    doc_knobs = set(re.findall(r"`(FTS_(?:PROF|SLO)_[A-Z0-9_]+)`", doc))
+    assert code_knobs, "no FTS_PROF_*/FTS_SLO_* knobs found (parser drift?)"
+    assert code_knobs - doc_knobs == set(), (
+        f"env knobs missing from the doc: {sorted(code_knobs - doc_knobs)}"
+    )
+    assert doc_knobs - code_knobs == set(), (
+        f"doc names knobs the code no longer reads: "
+        f"{sorted(doc_knobs - code_knobs)}"
+    )
+
+
 def _wire_ops():
     """Every RPC op name `LedgerServer._dispatch_op` handles (the live
     wire protocol, ops plane included)."""

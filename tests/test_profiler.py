@@ -1,0 +1,239 @@
+"""Host-path profiler: zero-cost-when-off, exclusive sub-leg timing,
+bounded sampling, and the differential no-perturbation contract.
+
+The profiler is an observer: off (the default) it must add NO threads
+and leave the leg timers as passthroughs; on, it may only aggregate —
+accept/reject verdicts of an identical workload must not change.
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.services.network import BlockPolicy, Network, TxStatus
+from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+from fabric_token_sdk_tpu.utils import metrics as mx
+from fabric_token_sdk_tpu.utils import profiler
+
+
+@pytest.fixture(autouse=True)
+def _sampler_off():
+    yield
+    profiler.stop()
+
+
+# ===================================================================
+# zero cost when off
+# ===================================================================
+
+
+def test_off_means_zero_profiler_threads(monkeypatch):
+    monkeypatch.delenv("FTS_PROF_HZ", raising=False)
+    assert profiler.start() is None
+    assert profiler.active() is None
+    monkeypatch.setenv("FTS_PROF_HZ", "0")
+    assert profiler.start() is None
+    names = [t.name for t in threading.enumerate()]
+    assert not any(n.startswith("fts-prof") for n in names)
+
+
+def test_leg_is_passthrough_without_collector():
+    before_totals = profiler.leg_totals()
+    before_count = mx.REGISTRY.histogram("ledger.host.unmarshal.seconds").count
+    with profiler.leg("unmarshal"):
+        pass
+    assert profiler.leg_totals() == before_totals
+    assert (
+        mx.REGISTRY.histogram("ledger.host.unmarshal.seconds").count
+        == before_count
+    )
+
+
+def test_start_stop_lifecycle():
+    p = profiler.start(hz=200.0)
+    assert p is not None and p.running()
+    assert any(t.name == "fts-prof" for t in threading.enumerate())
+    # idempotent: a second start returns the live sampler
+    assert profiler.start(hz=200.0) is p
+    stopped = profiler.stop()
+    assert stopped is p and not p.running()
+    assert profiler.active() is None
+    assert profiler.stop() is None
+
+
+# ===================================================================
+# exclusive sub-leg timing
+# ===================================================================
+
+
+def test_nested_legs_bill_exclusively():
+    with profiler.collect() as legs:
+        with profiler.leg("conservation"):
+            time.sleep(0.02)
+            with profiler.leg("sig_verify"):
+                time.sleep(0.03)
+            time.sleep(0.01)
+    # the inner leg's wall time is excluded from the outer leg's self
+    # time — the legs sum toward, never beyond, the window's wall clock
+    assert legs["sig_verify"] >= 0.03
+    assert 0.02 <= legs["conservation"] < 0.06
+    assert legs["conservation"] + legs["sig_verify"] < 0.09
+
+
+def test_collect_windows_restore_and_totals_accumulate():
+    t0 = profiler.leg_totals().get("input_match", 0.0)
+    with profiler.collect() as outer:
+        with profiler.leg("input_match"):
+            pass
+        with profiler.collect() as inner:
+            with profiler.leg("input_match"):
+                pass
+        assert "input_match" in inner
+    assert "input_match" in outer
+    # cumulative totals saw both windows
+    assert profiler.leg_totals()["input_match"] >= t0
+    # outside any window: passthrough again
+    before = profiler.leg_totals()
+    with profiler.leg("input_match"):
+        pass
+    assert profiler.leg_totals() == before
+
+
+# ===================================================================
+# bounded sampling + roles
+# ===================================================================
+
+
+def _parked_thread(name, release, role=None, depth=0):
+    """Park a thread `depth` recursion frames deep — distinct depths
+    yield distinct collapsed stacks (same frames, different counts)."""
+    ready = threading.Event()
+
+    def park(d):
+        if d > 0:
+            return park(d - 1)
+        if role:
+            profiler.set_thread_role(role)
+        ready.set()
+        release.wait(timeout=30)
+
+    t = threading.Thread(target=park, args=(depth,), name=name, daemon=True)
+    t.start()
+    ready.wait(timeout=10)
+    return t
+
+
+def test_sampler_table_is_bounded_and_drops_are_counted():
+    release = threading.Event()
+    threads = [
+        _parked_thread(f"park-{i}", release, depth=i) for i in range(3)
+    ]
+    try:
+        p = profiler.SamplingProfiler(hz=0, max_stacks=1)
+        p.sample()
+        assert p.stack_count() == 1
+        assert p.dropped >= 1
+        assert p.samples == 1
+        # known stacks keep counting even at the cap
+        p.sample()
+        assert p.stack_count() == 1
+        assert sum(p.collapsed().values()) >= 2
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+
+
+def test_roles_registration_and_name_classification():
+    release = threading.Event()
+    threads = [
+        _parked_thread("worker-x", release, role="client"),
+        _parked_thread("fts-block-commit", release),
+    ]
+    try:
+        p = profiler.SamplingProfiler(hz=0, max_stacks=100)
+        p.sample()
+        assert p.collapsed(role="client"), p.collapsed()
+        assert p.collapsed(role="commit-worker"), p.collapsed()
+        # collapsed keys are flamegraph-shaped: role;mod:func;...
+        for key in p.collapsed(role="client"):
+            assert key.startswith("client;")
+            assert ":" in key.split(";", 1)[1]
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+
+
+# ===================================================================
+# differential: profiling never perturbs verdicts
+# ===================================================================
+
+
+def _run_scenario():
+    """A deterministic mixed-verdict workload: issue, two transfers of
+    which the second double-spends. Returns ([statuses], breakdown)."""
+    pp = FabTokenPublicParams()
+    network = Network(
+        RequestValidator(FabTokenDriver(pp)),
+        policy=BlockPolicy(max_block_txs=8),
+    )
+    parties = {
+        name: Party(name, FabTokenDriver(pp), network)
+        for name in ("issuer-node", "alice-node", "bob-node")
+    }
+    parties["issuer-node"].new_issuer_wallet("issuer")
+    alice = parties["alice-node"].new_owner_wallet("alice", anonymous=False)
+    bob = parties["bob-node"].new_owner_wallet("bob", anonymous=False)
+    tx = Transaction(parties["issuer-node"], "seed")
+    tx.issue("issuer", "USD", [5], [alice.recipient_identity()],
+             anonymous=False)
+    tx.collect_endorsements(None)
+    tx.submit()
+    alice_p = parties["alice-node"]
+    tid = alice_p.vault.token_ids()[0]
+
+    def spend(anchor):
+        req = alice_p.tms.new_request(anchor)
+        tokens, metas = alice_p.vault.get_many([tid])
+        alice_p.tms.add_transfer(
+            req, [tid], tokens, metas, "USD", [5],
+            [bob.recipient_identity()],
+        )
+        alice_p.tms.sign_transfers(req)
+        return req.to_bytes()
+
+    events = network.submit_many([spend("pay-ok"), spend("pay-dup")])
+    bd = network.health()["last_block"]["breakdown"]
+    return [e.status for e in events], bd
+
+
+def test_sampler_never_perturbs_verdicts():
+    base_statuses, base_bd = _run_scenario()
+    assert base_statuses == [TxStatus.VALID, TxStatus.INVALID]
+    p = profiler.start(hz=500.0)
+    assert p is not None
+    try:
+        prof_statuses, prof_bd = _run_scenario()
+    finally:
+        profiler.stop()
+    assert prof_statuses == base_statuses
+    # both runs decomposed the host leg the same way (keys, not timings)
+    for leg_name in profiler.LEGS:
+        assert f"host_{leg_name}_s" in base_bd
+        assert f"host_{leg_name}_s" in prof_bd
+
+
+def test_breakdown_sublegs_cover_host_leg():
+    _statuses, bd = _run_scenario()
+    host = bd["host_validate_s"]
+    sublegs = sum(bd[f"host_{leg}_s"] for leg in profiler.LEGS)
+    assert host > 0
+    # exclusive sub-legs never sum past the leg they decompose (small
+    # epsilon: the breakdown rounds each leg to 1us independently)
+    assert sublegs <= host + 1e-3
+    # and they explain most of it — the attribution the PR exists for
+    assert sublegs / host > 0.5, bd

@@ -378,6 +378,14 @@ def test_faults_env_parse_count_and_kinds():
     faults.clear()
     assert faults.armed() == {}
     faults.fire("t.site")  # disarmed: plain no-op
+    # empty optional fields keep their defaults (unlimited count here)
+    assert faults.load_env("t.skip:delay:1.0::0.05") == 1
+    assert faults.armed()["t.skip"] == "delay"
+    t0 = time.monotonic()
+    faults.fire("t.skip")
+    faults.fire("t.skip")  # count '' = unlimited: still armed
+    assert time.monotonic() - t0 >= 0.08
+    faults.clear()
     with pytest.raises(ValueError):
         faults.load_env("missing-kind")
     with pytest.raises(ValueError):

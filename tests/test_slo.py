@@ -1,0 +1,348 @@
+"""SLO engine: window math, burn/budget rows, breach events, slow-tx
+exemplars, the extended bench schema sections, and the `compare --slo`
+gate.
+
+Also pins the Histogram invariant the engine depends on: adding the
+windowed `state()`/`fraction_le` readers changed NOTHING about the
+cumulative `snapshot()`/`to_prometheus()` output (byte-stability).
+"""
+import argparse
+import json
+import os
+import sys
+
+import pytest
+
+from fabric_token_sdk_tpu.utils import benchschema
+from fabric_token_sdk_tpu.utils import metrics as mx
+from fabric_token_sdk_tpu.utils import slo
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cmd"))
+import ftstop  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine():
+    slo.reset()
+    yield
+    slo.reset()
+
+
+# ===================================================================
+# fraction_le: the windowed bucket-delta quantile primitive
+# ===================================================================
+
+
+def test_fraction_le_basics():
+    buckets = (0.1, 1.0, 10.0)
+    # counts per bucket: <=0.1: 6, <=1.0: 2, <=10.0: 1, +Inf: 1
+    counts = [6, 2, 1, 1]
+    f = mx.Histogram.fraction_le
+    assert f(buckets, [0, 0, 0, 0], 1.0) is None  # no traffic
+    assert f(buckets, counts, 0.1) == pytest.approx(0.6)
+    assert f(buckets, counts, 1.0) == pytest.approx(0.8)
+    assert f(buckets, counts, 10.0) == pytest.approx(0.9)
+    # interpolation inside a bucket: halfway through (0.1, 1.0]
+    assert f(buckets, counts, 0.55) == pytest.approx(0.7)
+    # the +Inf bucket is never good, whatever the threshold
+    assert f(buckets, counts, 1e9) == pytest.approx(0.9)
+    # below the first bucket: nothing provably good
+    assert f(buckets, counts, 0.0) == pytest.approx(0.0)
+
+
+def test_fraction_le_matches_observed_stream():
+    h = mx.Histogram("slo.check", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 2.0):
+        h.observe(v)
+    counts, count, _s = h.state()
+    assert count == 5
+    assert mx.Histogram.fraction_le(h.buckets, counts, 0.1) == pytest.approx(
+        3 / 5
+    )
+
+
+# ===================================================================
+# engine rows: latency, availability, breach transition
+# ===================================================================
+
+
+def _drive(engine, finality_obs=(), commit_obs=(), bp=0, enq=0):
+    for v in finality_obs:
+        mx.REGISTRY.histogram(slo._HIST_FINALITY).observe(v)
+    for v in commit_obs:
+        mx.REGISTRY.histogram(slo._HIST_COMMIT).observe(v)
+    if enq:
+        mx.REGISTRY.counter(slo._CTR_ENQUEUED).inc(enq)
+    if bp:
+        mx.REGISTRY.counter(slo._CTR_BACKPRESSURE).inc(bp)
+    return engine.evaluate()
+
+
+def test_healthy_window_is_ok():
+    engine = slo.reset(window_s=60.0, finality_p99_s=1.0, commit_p99_s=1.0)
+    out = _drive(engine, finality_obs=[0.01] * 50, commit_obs=[0.02] * 5,
+                 enq=50)
+    assert out["window_s"] == 60.0
+    for name in ("finality_p99", "commit_p99", "availability"):
+        row = out["slos"][name]
+        assert row["ok"] is True, (name, row)
+        assert row["burn"] < 1.0
+        assert row["budget_remaining"] > 0.0
+    assert out["slos"]["finality_p99"]["target_s"] == 1.0
+    assert out["slos"]["finality_p99"]["total"] == 50
+    assert out["slos"]["availability"]["total"] == 50
+
+
+def test_empty_window_burns_nothing():
+    engine = slo.reset(window_s=60.0)
+    out = engine.evaluate()
+    for row in out["slos"].values():
+        assert row["ok"] is True
+        assert row["burn"] == 0.0
+        assert row["good_frac"] is None
+        assert row["total"] == 0
+
+
+def test_slow_tail_breaches_and_emits_flight_once():
+    engine = slo.reset(window_s=60.0, finality_p99_s=0.1)
+    breaches0 = mx.REGISTRY.counter("slo.breaches").value
+    # 10% of txs blow the 100ms target: good_frac 0.9 << 0.99 objective
+    out = _drive(engine, finality_obs=[0.01] * 9 + [5.0], enq=10)
+    row = out["slos"]["finality_p99"]
+    assert row["ok"] is False
+    assert row["burn"] >= 1.0
+    assert row["budget_remaining"] == 0.0
+    assert mx.REGISTRY.counter("slo.breaches").value == breaches0 + 1
+    evt = [e for e in mx.FLIGHT.tail() if e["kind"] == "slo.breach"][-1]
+    assert evt["slo"] == "finality_p99"
+    assert evt["burn"] >= 1.0
+    # still breaching: no second transition, no second flight event
+    engine._last_tick = 0.0
+    out = _drive(engine, finality_obs=[5.0], enq=1)
+    assert out["slos"]["finality_p99"]["ok"] is False
+    assert mx.REGISTRY.counter("slo.breaches").value == breaches0 + 1
+    # burn/budget gauges track the live row
+    assert mx.REGISTRY.gauge("slo.burn.finality_p99").value >= 1.0
+    assert mx.REGISTRY.gauge("slo.budget.finality_p99").value == 0.0
+
+
+def test_availability_counts_backpressure_as_bad():
+    engine = slo.reset(window_s=60.0, availability=0.9)
+    out = _drive(engine, enq=8, bp=2)  # 8 admitted of 10 attempts
+    row = out["slos"]["availability"]
+    assert row["total"] == 10
+    assert row["good_frac"] == pytest.approx(0.8)
+    assert row["ok"] is False  # 20% shed >> the 10% budget
+    out = _drive(engine, enq=1)  # within the SAME window: still bad
+    assert out["slos"]["availability"]["ok"] is False
+
+
+def test_health_section_rides_network_health():
+    from fabric_token_sdk_tpu.api.validator import RequestValidator
+    from fabric_token_sdk_tpu.drivers.fabtoken import (
+        FabTokenDriver, FabTokenPublicParams,
+    )
+    from fabric_token_sdk_tpu.services.network import Network
+
+    net = Network(RequestValidator(FabTokenDriver(FabTokenPublicParams())))
+    h = net.health()
+    assert set(h["slo"]["slos"]) == {
+        "finality_p99", "commit_p99", "availability",
+    }
+
+
+# ===================================================================
+# slow-tx exemplars
+# ===================================================================
+
+
+def test_exemplar_ring_keeps_k_slowest_in_order(monkeypatch):
+    monkeypatch.setenv("FTS_SLO_EXEMPLARS", "3")
+    for i, s in enumerate([0.1, 0.5, 0.3, 0.9, 0.2, 0.7]):
+        slo.record_exemplar(s, f"tx-{i}", f"tr-{i}")
+    top = slo.exemplars()
+    assert [t[0] for t in top] == [0.9, 0.7, 0.5]
+    assert [t[1] for t in top] == ["tx-3", "tx-5", "tx-1"]
+    # published into registry meta for the sidecar / ftsmetrics show
+    meta = mx.REGISTRY.snapshot()["meta"]["slo.exemplars"]
+    assert meta[0][1] == "tx-3" and meta[0][2] == "tr-3"
+
+
+def test_exemplars_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("FTS_SLO_EXEMPLARS", "0")
+    slo.record_exemplar(9.0, "tx-x", None)
+    assert slo.exemplars() == []
+
+
+def test_finality_resolution_records_exemplars():
+    from fabric_token_sdk_tpu.api.validator import RequestValidator
+    from fabric_token_sdk_tpu.drivers.fabtoken import (
+        FabTokenDriver, FabTokenPublicParams,
+    )
+    from fabric_token_sdk_tpu.services.network import Network
+    from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+
+    pp = FabTokenPublicParams()
+    net = Network(RequestValidator(FabTokenDriver(pp)))
+    party = Party("issuer-node", FabTokenDriver(pp), net)
+    party.new_issuer_wallet("issuer")
+    owner = party.new_owner_wallet("self", anonymous=False)
+    tx = Transaction(party, "slo-seed")
+    tx.issue("issuer", "USD", [3], [owner.recipient_identity()],
+             anonymous=False)
+    tx.collect_endorsements(None)
+    tx.submit()
+    assert any(t[1] == "slo-seed" for t in slo.exemplars())
+
+
+# ===================================================================
+# histogram byte-stability: windowed readers change no cumulative output
+# ===================================================================
+
+
+def test_snapshot_and_prometheus_unchanged_by_windowed_readers():
+    obs = (0.004, 0.03, 0.03, 0.7, 12.0)
+
+    def build():
+        h = mx.Histogram("net.check.seconds")
+        for v in obs:
+            h.observe(v)
+        return h
+
+    virgin = build()
+    snap_before = json.dumps(virgin.snapshot(), sort_keys=True)
+
+    probed = build()
+    # exercise the new read-only surface between observes and snapshot
+    state = probed.state()
+    assert state[1] == len(obs)
+    mx.Histogram.fraction_le(probed.buckets, state[0], 0.05)
+    probed.observe  # attribute access only; no further observes
+    snap_after = json.dumps(probed.snapshot(), sort_keys=True)
+    assert snap_before == snap_after
+
+    # Prometheus exposition is byte-identical too (same registry name)
+    reg_a, reg_b = mx.Registry(), mx.Registry()
+    for v in obs:
+        reg_a.histogram("net.check.seconds").observe(v)
+        reg_b.histogram("net.check.seconds").observe(v)
+    reg_b.histogram("net.check.seconds").state()
+    assert reg_a.to_prometheus() == reg_b.to_prometheus()
+    # state() is a copy: mutating it cannot corrupt the histogram
+    counts, _c, _s = reg_b.histogram("net.check.seconds").state()
+    counts[0] = 10 ** 9
+    assert reg_a.to_prometheus() == reg_b.to_prometheus()
+
+
+# ===================================================================
+# bench schema: profile + slo sections
+# ===================================================================
+
+
+def _base_result():
+    # a schema-valid base: the repo's own latest recorded round, with
+    # any prior profile/slo sections stripped so tests attach their own
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_history.jsonl")
+    rows = benchschema.load_history(path)
+    base = dict(benchschema.extract_result(rows[-1]))
+    base.pop("profile", None)
+    base.pop("slo", None)
+    assert benchschema.validate_result(base) == []
+    return base
+
+
+def test_validate_profile_section():
+    good = {
+        "hz": 47.0, "samples": 10,
+        "host_legs": {"unmarshal": 0.1, "sig_verify": 0.0},
+        "host_leg_coverage": 0.91,
+        "stacks": {"commit-worker;a:b": 7},
+        "dropped_stacks": 0,
+    }
+    assert benchschema.validate_profile(good) == []
+    assert benchschema.validate_profile({"hz": 1.0}) != []  # missing keys
+    bad = dict(good, host_legs={"unmarshal": -0.1})
+    assert benchschema.validate_profile(bad) != []
+    bad = dict(good, stacks={"s": 0})
+    assert benchschema.validate_profile(bad) != []
+    # a result carrying the section is gated through validate_result
+    r = dict(_base_result(), profile=good)
+    assert benchschema.validate_result(r) == []
+    r = dict(_base_result(), profile={"hz": 1.0})
+    assert benchschema.validate_result(r) != []
+
+
+def test_validate_slo_section():
+    row = {"objective": 0.99, "burn": 0.2, "budget_remaining": 0.8,
+           "total": 100, "ok": True}
+    good = {"window_s": 60.0, "slos": {"finality_p99": row}}
+    assert benchschema.validate_slo(good) == []
+    assert benchschema.validate_slo({"slos": {}}) != []  # no window
+    bad = {"window_s": 60.0, "slos": {"x": {"burn": 0.2}}}
+    problems = benchschema.validate_slo(bad)
+    assert problems and "x" in problems[0]
+    r = dict(_base_result(), slo=good)
+    assert benchschema.validate_result(r) == []
+
+
+def test_live_engine_output_is_schema_valid():
+    engine = slo.reset(window_s=60.0)
+    out = _drive(engine, finality_obs=[0.01] * 3, commit_obs=[0.01], enq=3)
+    assert benchschema.validate_slo(out) == []
+
+
+# ===================================================================
+# ftstop compare --slo gate
+# ===================================================================
+
+
+def _history(tmp_path, results):
+    p = tmp_path / "hist.jsonl"
+    with open(p, "w") as fh:
+        for r in results:
+            fh.write(json.dumps(r) + "\n")
+    return str(p)
+
+
+def _args(history, no_fail=False):
+    return argparse.Namespace(
+        history=history, last=None, threshold=0.1, no_fail=no_fail,
+    )
+
+
+def _slo_section(ok):
+    return {"window_s": 60.0, "slos": {
+        "finality_p99": {"objective": 0.99, "good_frac": 1.0 if ok else 0.5,
+                         "total": 10, "burn": 0.0 if ok else 50.0,
+                         "budget_remaining": 1.0 if ok else 0.0,
+                         "ok": ok, "target_s": 1.0},
+    }}
+
+
+def test_compare_slo_exit_codes(tmp_path, capsys):
+    healthy = dict(_base_result(), slo=_slo_section(True))
+    breached = dict(_base_result(), slo=_slo_section(False))
+    assert ftstop.compare_slo(_args(_history(tmp_path, [healthy]))) == 0
+    # the LATEST slo-carrying round decides
+    assert ftstop.compare_slo(
+        _args(_history(tmp_path, [healthy, breached]))
+    ) == 1
+    assert ftstop.compare_slo(
+        _args(_history(tmp_path, [breached, healthy]))
+    ) == 0
+    assert ftstop.compare_slo(
+        _args(_history(tmp_path, [healthy, breached]), no_fail=True)
+    ) == 0
+    # no slo-carrying rounds at all
+    assert ftstop.compare_slo(_args(_history(tmp_path, [_base_result()]))) == 2
+    out = capsys.readouterr()
+    assert "BREACH" in out.out
+
+
+def test_compare_slo_is_wired_into_main(tmp_path):
+    healthy = dict(_base_result(), slo=_slo_section(True))
+    rc = ftstop.main(
+        ["compare", "--history", _history(tmp_path, [healthy]), "--slo"]
+    )
+    assert rc == 0
