@@ -32,6 +32,14 @@ deadline — so even a timed-out run (rc=124) leaves a full accounting of
 $FTS_METRICS_SIDECAR (default BENCH.metrics.json; flight dump derived).
 Inspect with `python cmd/ftsmetrics.py show BENCH.metrics.json` and
 `python cmd/ftstrace.py tail BENCH.flight.json`.
+
+The headline and soak phases also record the device-plane dispatch
+ledger (`utils/devobs.py`; `FTS_DEVOBS=0` disables) as the
+schema-validated `device` section of the result: batch occupancy,
+padding waste, per-program dispatch wall and compile forensics. Gate it
+in CI with `python cmd/ftstop.py compare --history BENCH_history.jsonl
+--device`; render a recorded round with `python cmd/ftstrace.py
+devices BENCH_history.jsonl`.
 """
 
 from __future__ import annotations
@@ -1002,6 +1010,13 @@ def _soak(hb, zk_pp=None) -> dict:
     # SLO verdict over the soak window (engine was reset at soak start,
     # so the sliding window saw only soak traffic)
     soak["slo"] = slo.ENGINE.evaluate()
+    # device-plane dispatch ledger THROUGH the soak (cumulative since
+    # process start — the section `ftstop compare --device` gates and
+    # `ftstrace devices` renders); supersedes the headline-phase record
+    from fabric_token_sdk_tpu.utils import devobs
+
+    if devobs.enabled():
+        soak["device"] = devobs.section()
     mx.gauge("bench.soak_txs_per_s").set(soak["steady_txs_per_s"])
     if p99 is not None:
         mx.gauge("bench.soak_p99_finality_s").set(soak["p99_finality_s"])
@@ -1430,6 +1445,14 @@ def main() -> None:
         prove_degraded=prove_degraded, setup_s=setup_s,
         stage_warmup_s=float(mx.REGISTRY.gauge("bench.stage_warmup_s").value or 0),
     )
+    # device-plane dispatch ledger of the headline phase (occupancy,
+    # padding waste, per-program compile forensics — utils/devobs.py);
+    # refreshed after the soak so the recorded section covers every
+    # phase that dispatched
+    from fabric_token_sdk_tpu.utils import devobs
+
+    if devobs.enabled():
+        result["device"] = devobs.section()
     # The headline is secured the moment it exists: print it (and disarm
     # the watchdog) BEFORE the fallible block phase, so a hang or crash
     # there can never cost the completed accelerator measurement.
@@ -1483,7 +1506,7 @@ def main() -> None:
                 # profile/slo ride inside the soak dict so direct _soak
                 # callers (tests) see them; in the recorded result they
                 # are schema-validated top-level sections of their own
-                for section in ("profile", "slo"):
+                for section in ("profile", "slo", "device"):
                     if section in soak:
                         result[section] = soak.pop(section)
                 result["soak"] = soak

@@ -2,12 +2,14 @@
 
 Usage:
     python cmd/ftstop.py top HOST:PORT [--interval S] [--count N | --once]
+    python cmd/ftstop.py devices HOST:PORT [--interval S] [--count N | --once]
     python cmd/ftstop.py compare OLD.json NEW.json [--threshold F]
     python cmd/ftstop.py compare --history BENCH_history.jsonl [--last N]
     python cmd/ftstop.py compare --history BENCH_history.jsonl --scaling
     python cmd/ftstop.py compare --history BENCH_history.jsonl --soak
     python cmd/ftstop.py compare --history BENCH_history.jsonl --state
     python cmd/ftstop.py compare --history BENCH_history.jsonl --slo
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --device
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
@@ -16,6 +18,12 @@ poll, in-flight txs, tx/s (counter delta between polls), backpressure
 reject rate (`bp/s`), batched fraction, p95 block-commit and
 submit→finality latency (bucket-interpolated quantiles computed
 node-side), and process/device memory. Ctrl-C exits cleanly.
+
+`devices` polls the same `ops.health` RPC and renders the device-plane
+dispatch ledger (`utils/devobs.py`) as a per-program table: dispatches,
+mean occupancy, padding waste %, p50/p99 dispatch wall, dp x mp
+placement, compiles with their wall time, persistent-cache hits/misses,
+and degrade decisions (breaker-open skips, dispatch-error fallbacks).
 
 `compare` is the observatory: it diffs bench results against each other
 or against the history file `bench.py` appends every outcome to
@@ -183,6 +191,77 @@ def top(address, interval: float = None, count: Optional[int] = None,
             dt = (now - prev_t) if prev_t is not None else None
             print(format_row(health, snap, prev_snap, dt), file=out, flush=True)
             prev_snap, prev_t = snap, now
+            i += 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        net.close()
+    return 0
+
+
+# ------------------------------------------------------------ devices
+
+
+def _pct(v) -> str:
+    return "-" if v is None else f"{v:.1%}"
+
+
+def format_devices(health: dict) -> str:
+    """The per-program device-plane table from an `ops.health` dict
+    (pure — unit-testable without a socket). One header line with the
+    per-plane occupancy roll-up, one row per (plane, program)."""
+    dev = health.get("device")
+    if not isinstance(dev, dict):
+        return "devices: node predates the dispatch ledger"
+    planes = dev.get("planes") or {}
+    programs = dev.get("programs") or {}
+    head = "planes: " + (
+        "  ".join(
+            f"{name}[n={p.get('dispatches', 0)} "
+            f"occ={_pct(p.get('occupancy'))} "
+            f"waste={_pct(p.get('waste_frac'))}]"
+            for name, p in sorted(planes.items())
+        ) if planes else "(no dispatches yet)"
+    )
+    if not programs:
+        return head
+    lines = [head]
+    cols = (
+        f"{'plane':<8} {'program':<20} {'disp':>6} {'occ':>7} "
+        f"{'waste':>7} {'p50':>9} {'p99':>9} {'dpxmp':>6} "
+        f"{'compiles':>8} {'comp_s':>7} {'hit/miss':>9} {'degr':>5}"
+    )
+    lines.append(cols)
+    for _key, r in sorted(programs.items()):
+        lines.append(
+            f"{r.get('plane', '-'):<8} {r.get('program', '-'):<20} "
+            f"{r.get('dispatches', 0):>6} {_pct(r.get('occupancy')):>7} "
+            f"{_pct(r.get('waste_frac')):>7} {_s(r.get('p50_s')):>9} "
+            f"{_s(r.get('p99_s')):>9} "
+            f"{r.get('dp', 1)}x{r.get('mp', 1):<3} "
+            f"{r.get('compiles', 0):>8} {r.get('compile_s', 0):>7g} "
+            f"{r.get('cache_hits', 0)}/{r.get('cache_misses', 0):<4} "
+            f"{r.get('degrades', 0):>5}"
+        )
+    return "\n".join(lines)
+
+
+def devices(address, interval: float = None, count: Optional[int] = None,
+            out=None) -> int:
+    """Poll a node's ops plane and print the device ledger per poll."""
+    from fabric_token_sdk_tpu.services.network.remote import RemoteNetwork
+
+    if interval is None:
+        interval = float(os.environ.get("FTS_OPS_INTERVAL_S", "2"))
+    out = out if out is not None else sys.stdout
+    addr = parse_address(address) if isinstance(address, str) else tuple(address)
+    net = RemoteNetwork(addr)
+    i = 0
+    try:
+        while count is None or i < count:
+            if i:
+                time.sleep(interval)
+            print(format_devices(net.ops_health()), file=out, flush=True)
             i += 1
     except KeyboardInterrupt:
         pass
@@ -462,6 +541,42 @@ def compare_state(args) -> int:
     )
 
 
+def device_of(result: dict) -> Optional[dict]:
+    """The `device` section of one schema-valid bench result, or None.
+    (Callers filter through `validate_result` first, which already
+    field-checks any dict-typed device section.)"""
+    s = result.get("device")
+    return s if isinstance(s, dict) else None
+
+
+# (device field, direction): +1 = higher is better, -1 = lower is better
+DEVICE_METRICS = (
+    ("occupancy", +1),
+    ("waste_frac", -1),
+    ("dispatch_p99_s", -1),
+)
+
+
+def compare_device(args) -> int:
+    """The device-plane observatory: gate the dispatch ledger's
+    efficiency numbers — batch occupancy regresses when it DROPS,
+    padding waste and p99 dispatch wall when they GROW — against the
+    per-metric MEDIAN of the prior device-carrying history rounds (same
+    contract as `--scaling`/`--soak`/`--state`)."""
+    return _gate_sections(
+        args, "device", device_of, DEVICE_METRICS,
+        lambda s: (
+            f"device plane, latest round: dispatches={s['dispatches']} "
+            f"occupancy={s.get('occupancy')} "
+            f"waste={s.get('waste_frac')} "
+            f"p99={s.get('dispatch_p99_s')}s "
+            f"compiles={s.get('compiles', 0)} "
+            f"degrades={s.get('degrades', 0)} "
+            f"planes={','.join(sorted((s.get('planes') or {})))}"
+        ),
+    )
+
+
 def compare_slo(args) -> int:
     """The SLO gate: unlike the regression observatories (which diff
     against prior rounds), this is an ABSOLUTE verdict on the latest
@@ -615,6 +730,17 @@ def main(argv=None) -> int:
                        help="stop after N polls (default: forever)")
     p_top.add_argument("--once", action="store_true",
                        help="one poll, then exit (same as --count 1)")
+    p_dev = sub.add_parser(
+        "devices",
+        help="per-program device dispatch ledger of a running node",
+    )
+    p_dev.add_argument("address", help="HOST:PORT of a LedgerServer")
+    p_dev.add_argument("--interval", type=float, default=None,
+                       help="poll interval seconds (FTS_OPS_INTERVAL_S)")
+    p_dev.add_argument("--count", type=int, default=None,
+                       help="stop after N polls (default: forever)")
+    p_dev.add_argument("--once", action="store_true",
+                       help="one poll, then exit (same as --count 1)")
     p_cmp = sub.add_parser("compare", help="diff bench rounds for regressions")
     p_cmp.add_argument("old", nargs="?", help="old result/round JSON")
     p_cmp.add_argument("new", nargs="?", help="new result/round JSON")
@@ -644,12 +770,20 @@ def main(argv=None) -> int:
                              "when any error budget is exhausted — absolute, "
                              "not relative to prior rounds (history mode "
                              "only)")
+    p_gate.add_argument("--device", action="store_true",
+                        help="gate on the device-plane dispatch ledger: batch "
+                             "occupancy (drop), padding waste and p99 "
+                             "dispatch wall (growth) vs the median of prior "
+                             "device-carrying rounds (history mode only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
     if args.cmd == "top":
         return top(args.address, args.interval,
                    1 if args.once else args.count)
+    if args.cmd == "devices":
+        return devices(args.address, args.interval,
+                       1 if args.once else args.count)
     if args.scaling:
         if not args.history:
             ap.error("compare --scaling needs --history")
@@ -666,6 +800,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --slo needs --history")
         return compare_slo(args)
+    if args.device:
+        if not args.history:
+            ap.error("compare --device needs --history")
+        return compare_device(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

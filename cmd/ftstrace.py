@@ -5,6 +5,7 @@ Usage:
     python cmd/ftstrace.py export -o chrome_trace.json <sidecar.json> [...]
     python cmd/ftstrace.py tail [-n N] <flight.json>
     python cmd/ftstrace.py flame [--role ROLE] <result-or-history.json>
+    python cmd/ftstrace.py devices [--plane PLANE] <result-or-history.json>
 
 Inputs are any mix of ``*.metrics.json`` (span trees — what
 ``Registry.snapshot()`` flushes) and ``*.flight.json`` (flight-recorder
@@ -23,7 +24,12 @@ flight-recorder events of a crash dump — the first thing to read after
 an rc=124. `flame` dumps the host-path sampling profile of a bench
 result (the `profile.stacks` section `bench.py` records when
 `FTS_PROF_HZ` > 0) in collapsed-stack format — pipe it straight into
-flamegraph.pl or paste into speedscope.app.
+flamegraph.pl or paste into speedscope.app. `devices` renders the
+device-plane dispatch ledger of a bench result (the `device` section,
+`utils/devobs.py`) as a per-program breakdown — dispatches, occupancy,
+padding waste, wall share, compile forensics — from a result JSON or
+the latest device-carrying round of `BENCH_history.jsonl` (same
+dual-source rule as `flame`).
 """
 
 from __future__ import annotations
@@ -261,24 +267,30 @@ def tail(path: str, n: int = 20) -> int:
     return 0
 
 
-def _profile_of(path: str) -> Optional[dict]:
-    """The `profile` section of a bench result file, or of the LATEST
-    profile-carrying round of a history jsonl."""
+def _section_of(path: str, name: str) -> Optional[dict]:
+    """A named dict section of a bench result file, or of the LATEST
+    section-carrying round of a history jsonl."""
     if path.endswith(".jsonl"):
         sys.path.insert(
             0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
         )
         from fabric_token_sdk_tpu.utils import benchschema
 
-        prof = None
+        found = None
         for row in benchschema.load_history(path):
             result = benchschema.extract_result(row)
-            if result and isinstance(result.get("profile"), dict):
-                prof = result["profile"]
-        return prof
+            if result and isinstance(result.get(name), dict):
+                found = result[name]
+        return found
     doc = _load(path)
-    p = doc.get("profile")
-    return p if isinstance(p, dict) else None
+    s = doc.get(name)
+    return s if isinstance(s, dict) else None
+
+
+def _profile_of(path: str) -> Optional[dict]:
+    """The `profile` section of a bench result file, or of the LATEST
+    profile-carrying round of a history jsonl."""
+    return _section_of(path, "profile")
 
 
 def flame(path: str, role: Optional[str] = None, out=None) -> int:
@@ -306,6 +318,76 @@ def flame(path: str, role: Optional[str] = None, out=None) -> int:
         return 1
     for stack, count in sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0])):
         print(f"{stack} {count}", file=out)
+    return 0
+
+
+def _frac(v) -> str:
+    return "-" if not isinstance(v, (int, float)) else f"{v:.1%}"
+
+
+def devices(path: str, plane: Optional[str] = None, out=None) -> int:
+    """Render the per-program device dispatch breakdown of a recorded
+    bench round (`device` section): dispatches, occupancy, padding
+    waste, share of total dispatch wall, and compile forensics —
+    heaviest program first; `--plane` keeps one plane's programs."""
+    out = out if out is not None else sys.stdout
+    dev = _section_of(path, "device")
+    if dev is None:
+        print(f"{path}: no device section (recorded by bench.py when the "
+              "dispatch ledger is on — FTS_DEVOBS, default on)",
+              file=sys.stderr)
+        return 1
+    programs = dev.get("programs") or {}
+    if plane:
+        programs = {
+            k: r for k, r in programs.items()
+            if isinstance(r, dict) and r.get("plane") == plane
+        }
+    if not programs:
+        planes = sorted((dev.get("planes") or {}))
+        print(
+            f"{path}: no programs"
+            + (f" for plane {plane!r} (planes seen: "
+               f"{', '.join(planes) or '-'})" if plane else " recorded"),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"== device plane: {dev.get('dispatches', 0)} dispatches  "
+        f"occupancy={_frac(dev.get('occupancy'))}  "
+        f"waste={_frac(dev.get('waste_frac'))}  "
+        f"p99={dev.get('dispatch_p99_s')}s  "
+        f"compiles={dev.get('compiles', 0)} "
+        f"({dev.get('compile_s', 0)}s)  "
+        f"cache={dev.get('cache_hits', 0)}h/"
+        f"{dev.get('cache_misses', 0)}m  "
+        f"degrades={dev.get('degrades', 0)}",
+        file=out,
+    )
+    total_wall = sum(
+        r.get("wall_s", 0.0) for r in programs.values()
+        if isinstance(r, dict)
+    )
+    rows = sorted(
+        (r for r in programs.values() if isinstance(r, dict)),
+        key=lambda r: -r.get("wall_s", 0.0),
+    )
+    for r in rows:
+        share = (
+            r.get("wall_s", 0.0) / total_wall if total_wall else 0.0
+        )
+        print(
+            f"  {r.get('plane', '-'):<8} {r.get('program', '-'):<20} "
+            f"disp={r.get('dispatches', 0):<6} "
+            f"occ={_frac(r.get('occupancy')):<6} "
+            f"waste={_frac(r.get('waste_frac')):<6} "
+            f"wall={_fmt_s(r.get('wall_s', 0.0)):>8} ({share:.0%}) "
+            f"p50={_fmt_s(r.get('p50_s') or 0.0):>8} "
+            f"p99={_fmt_s(r.get('p99_s') or 0.0):>8} "
+            f"compiles={r.get('compiles', 0)} "
+            f"degrades={r.get('degrades', 0)}",
+            file=out,
+        )
     return 0
 
 
@@ -337,6 +419,16 @@ def main(argv=None) -> int:
                            "stage-a-driver, remote-handler, client, other)")
     p_fl.add_argument("result",
                       help="bench result JSON or BENCH_history.jsonl")
+    p_dv = sub.add_parser(
+        "devices",
+        help="render a recorded round's per-program device dispatch "
+             "breakdown",
+    )
+    p_dv.add_argument("--plane", default=None,
+                      help="keep one plane's programs (verify, sign, "
+                           "prove, stages)")
+    p_dv.add_argument("result",
+                      help="bench result JSON or BENCH_history.jsonl")
     args = ap.parse_args(argv)
     if args.cmd == "timeline":
         return timeline(args.ident, args.sidecars)
@@ -344,6 +436,8 @@ def main(argv=None) -> int:
         return export(args.out, args.sidecars)
     if args.cmd == "flame":
         return flame(args.result, args.role)
+    if args.cmd == "devices":
+        return devices(args.result, args.plane)
     return tail(args.flight, args.n)
 
 

@@ -35,6 +35,7 @@ from .wellformedness import TransferWF, challenge_transfer_wf
 from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
     stages as st, tower as tw
 from ..parallel.sharding import MeshConfig
+from ..utils import devobs
 from ..utils import metrics as mx, resilience
 
 # Canonical tile height for all stage kernels (re-exported for compat;
@@ -64,12 +65,18 @@ class _MeshBound:
 
 
 def _spanned(name):
-    """Wrap a verify method in a metrics span (no-op when disabled)."""
+    """Wrap a verify method in a metrics span (no-op when disabled) and
+    a dispatch-ledger plane tag (`utils/devobs.py`): every stage
+    dispatch the method triggers records its occupancy under the plane
+    named by the span's middle token (`batch.sign.verify` -> `sign`,
+    every `batch.*.verify` verifier -> `verify`)."""
+    middle = name.split(".")[1] if "." in name else name
+    plane = middle if middle in ("sign", "prove") else "verify"
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kw):
-            with mx.span(name):
+            with devobs.plane(plane), mx.span(name):
                 return fn(*args, **kw)
 
         return wrapper

@@ -40,6 +40,7 @@ from .setup import PublicParams
 from .transfer import TransferProof, _skip_range
 from ..ops import curve as cv, curve2 as cv2, limbs as lb, pairing as pr, \
     stages as st, tower as tw
+from ..utils import devobs
 from ..utils import metrics as mx, resilience
 
 
@@ -271,7 +272,7 @@ class BatchedTransferProver(_MeshBound):
         if not reqs:
             return []
         n_in, n_out = self._check_shapes(reqs)
-        with mx.span(
+        with devobs.plane("prove"), mx.span(
             "batch.prove", txs=len(reqs), shape=f"({n_in},{n_out})"
         ):
             with mx.span("batch.prove.wf"):

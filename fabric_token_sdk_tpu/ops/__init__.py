@@ -153,6 +153,7 @@ except Exception:  # older jax without the knobs
 
 
 def _install_jax_monitoring() -> None:
+    from ..utils import devobs as _devobs
     from ..utils import metrics as _mx
 
     def _event_name(raw: str) -> str:
@@ -167,14 +168,23 @@ def _install_jax_monitoring() -> None:
             # starts MISSING the persistent cache shows up in the flight
             # ring right next to the phase that triggered it
             if "compilation_cache" in name:
-                _mx.flight("cache", event=_event_name(name))
+                ev = _event_name(name)
+                _mx.flight("cache", event=ev)
+                # listeners fire synchronously on the compiling thread,
+                # so the dispatch ledger's active frame names the
+                # program whose cache entry this was
+                _devobs.note_cache(ev)
 
         def _on_duration(name, duration, **kw):
             # the histogram's own `count` is the event count — e.g. the
             # backend_compile histogram count IS the distinct-program count
             _mx.REGISTRY.histogram(_event_name(name) + ".seconds").observe(duration)
             if "backend_compile" in name:
-                _mx.flight("compile", seconds=round(duration, 3))
+                _mx.flight(
+                    "compile", seconds=round(duration, 3),
+                    program=_devobs.current_program(),
+                )
+                _devobs.note_compile(duration)
 
         _mon.register_event_listener(_on_event)
         _mon.register_event_duration_secs_listener(_on_duration)

@@ -31,6 +31,7 @@ from jax import lax
 from . import limbs as lb, tower as tw
 from .field import FP
 from ..crypto import hostmath as hm
+from ..utils import devobs
 from ..utils import metrics as mx
 
 # ---------------------------------------------------------------- constants
@@ -447,7 +448,9 @@ def pairing_product_staged(Ps, Qs, inf_mask=None, dp=None, mp=None):
         # all inter-stage glue (concat/mask/reshape/pad) stays in numpy so
         # the ONLY device programs are the three tile kernels — no
         # per-shape concatenate/select programs on the accelerator
-        with mx.timed("pairing.staged.miller.seconds"):
+        with devobs.dispatch(
+            "miller_tile", rows=N, padded_rows=pad, dp=dp, mp=mp
+        ), mx.timed("pairing.staged.miller.seconds"):
             f = np.concatenate(
                 _sharded_tiles(
                     _miller_tiles, (N + pad) // MILLER_TILE, dp * mp, Pf, Qf
@@ -467,7 +470,9 @@ def pairing_product_staged(Ps, Qs, inf_mask=None, dp=None, mp=None):
                 [f, np.broadcast_to(one_np, (padB, K, 6, 2, L))], axis=0
             )
         mx.counter("pairing.staged.fexp_tiles").inc((B + padB) // FEXP_TILE)
-        with mx.timed("pairing.staged.product_fexp.seconds"):
+        with devobs.dispatch(
+            "fexp_tile", rows=B, padded_rows=padB, dp=dp
+        ), mx.timed("pairing.staged.product_fexp.seconds"):
             gts = _sharded_tiles(
                 _fexp_tiles, (B + padB) // FEXP_TILE, dp, f
             )

@@ -46,6 +46,7 @@ from jax import lax
 
 from . import curve as cv, curve2 as cv2, limbs as lb
 from .field import FP
+from ..utils import devobs
 from ..utils import metrics as mx
 from ..utils import resilience, sysmon
 from ..utils.tracing import logger
@@ -128,6 +129,11 @@ def mesh_env() -> tuple:
         if mp != want and _env_clamp_seen != (n, want):
             _env_clamp_seen = (n, want)
             mx.counter("sharding.clamped").inc()
+            mx.counter("sharding.clamped.env").inc()
+            mx.flight(
+                "sharding.clamped", where="env", want=want, got=mp,
+                n_devices=n,
+            )
             logger.warning(
                 "sharding: ambient mesh env clamped mp %d -> %d "
                 "(FTS_MESH_DEVICES=%d)", want, mp, n,
@@ -194,6 +200,12 @@ def run_tile_spans(fn, ntiles: int, workers: int, *args, calls, shards,
         return fn(*args, 0, ntiles)
     brk = resilience.breaker("stages")
     if not brk.allow():
+        # breaker-open skip: the open/close TRANSITIONS are already
+        # reasoned `breaker` flight events (utils/resilience.py); here
+        # we only count the skipped dispatches and charge the degrade
+        # to the active program's ledger entry
+        mx.counter("sharding.breaker_skips").inc()
+        devobs.note_degrade("breaker_open")
         return fn(*args, 0, ntiles)
     try:
         spans = dp_spans(ntiles, workers)
@@ -204,9 +216,15 @@ def run_tile_spans(fn, ntiles: int, workers: int, *args, calls, shards,
         shards.inc(len(spans))
         brk.record_success()
         return outs
-    except Exception:
+    except Exception as e:
         brk.record_failure()
         mx.counter("sharding.fallbacks").inc()
+        mx.flight(
+            "sharding.fallback", what=what, workers=workers,
+            reason="dispatch_error", error=type(e).__name__,
+            program=devobs.current_program(),
+        )
+        devobs.note_degrade("dispatch_error")
         logger.exception(
             "%s: sharded dispatch failed (workers=%d); re-running "
             "unsharded", what, workers,
@@ -226,6 +244,29 @@ def dp_spans(ntiles: int, dp: int):
         spans.append((at, at + n))
         at += n
     return spans
+
+
+_PROGRAM_NAMES = None
+
+
+def _program_of(kernel, arrays) -> str:
+    """Canonical program name (the `stage_programs()` registry key) of a
+    stage kernel — the join key the dispatch ledger (`utils/devobs.py`)
+    and the compile listeners attribute by. The msm tile is one jitted
+    fn serving three programs (disambiguated by the nbases axis of its
+    scalar rows); g1/g2 share `__name__` for add/scalar_mul, so the map
+    is keyed by function identity, not name."""
+    global _PROGRAM_NAMES
+    if kernel is _g1_msm_tile:
+        return f"g1_msm{arrays[0].shape[1]}_tile"
+    if _PROGRAM_NAMES is None:
+        names = {}
+        for name, fn, _shapes in stage_programs():
+            names.setdefault(id(fn), name)
+        _PROGRAM_NAMES = names
+    return _PROGRAM_NAMES.get(id(kernel)) or (
+        getattr(kernel, "__name__", None) or type(kernel).__name__
+    )
 
 
 def run_rows(kernel, *arrays, consts=(), dp=None):
@@ -269,11 +310,14 @@ def run_rows(kernel, *arrays, consts=(), dp=None):
     mx.counter("batch.tiled.transfers").inc(ntiles * len(arrays))
     dp = default_dp() if dp is None else max(1, dp)
     # per-stage device timing: one `stages.run` span per dispatch, named
-    # by the stage kernel — the per-kernel breakdown a critical-path
-    # trace (cmd/ftstrace.py) renders under the block's device verify
-    kname = getattr(kernel, "__name__", None) or type(kernel).__name__
+    # by the canonical program — the per-kernel breakdown a critical-path
+    # trace (cmd/ftstrace.py) renders under the block's device verify;
+    # the dispatch ledger (utils/devobs.py) records the same frame with
+    # occupancy and dp placement for the ops plane
+    kname = _program_of(kernel, arrays)
     t_dispatch = time.monotonic()
-    with mx.span("stages.run", kernel=kname, rows=N, tiles=ntiles):
+    with devobs.dispatch(kname, rows=N, padded_rows=pad, dp=dp), \
+            mx.span("stages.run", kernel=kname, rows=N, tiles=ntiles):
         outs = run_tile_spans(
             lambda a, b: _run_span(
                 kernel, consts, arrays, a * ROW_TILE, b * ROW_TILE

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from . import limbs as lb, pairing as pr, stages as st
+from ..utils import devobs
 from ..utils import metrics as mx
 
 _CACHE_COUNTERS = (
@@ -113,7 +114,11 @@ def warmup(
             for name, fn, shapes in all_programs(include_pairing, include_prover):
                 specs = [jax.ShapeDtypeStruct(s, jnp.int32) for s in shapes]
                 t0 = time.time()
-                fn.lower(*specs).compile()
+                # attribute the compile/cache events this AOT compile
+                # fires to the canonical program name — the ledger join
+                # between jax.monitoring and the program registry
+                with devobs.attribute(name):
+                    fn.lower(*specs).compile()
                 dt = time.time() - t0
                 mx.counter("warmup.programs").inc()
                 mx.REGISTRY.histogram("warmup.program.seconds").observe(dt)

@@ -54,19 +54,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import curve as cv, pairing as pr, stages as st, tower as tw
 from ..ops.field import FP
+from ..utils import devobs
 from ..utils import metrics as mx
 from ..utils.tracing import logger
 
 
 def _clamp_mp(n: int, mp: int, where: str) -> int:
     """Largest divisor of n that is <= mp (>= 1). A non-dividing mp is
-    CLAMPED, not rejected — counted so the observatory sees it."""
+    CLAMPED, not rejected — counted so the observatory sees it: the
+    aggregate `sharding.clamped` tick (pinned by tests/test_parallel.py),
+    a per-site `sharding.clamped.<where>` counter, and a
+    `sharding.clamped` flight event carrying the full decision."""
     mp = max(1, mp)
     want = mp
     while n % mp:
         mp -= 1
     if mp != want:
         mx.counter("sharding.clamped").inc()
+        mx.counter(f"sharding.clamped.{where.lower()}").inc()
+        mx.flight(
+            "sharding.clamped", where=where, want=want, got=mp,
+            n_devices=n,
+        )
         logger.warning(
             "sharding: %s clamped mp %d -> %d (n_devices=%d)",
             where, want, mp, n,
@@ -201,14 +210,29 @@ def sharded_pairing_product(Ps, Qs, mesh, fused: Optional[bool] = None):
         B, K = Ps.shape[0], Ps.shape[1]
         if K % cfg.mp:
             mx.counter("sharding.fallbacks").inc()
+            mx.flight(
+                "sharding.fallback", what="fused_pairing",
+                workers=cfg.workers, reason="k_not_divisible",
+                k=K, mp=cfg.mp,
+            )
+            devobs.note_degrade(
+                "k_not_divisible", program="fused_pairing"
+            )
             logger.warning(
                 "sharding: fused pairing product needs K %% mp == 0 "
                 "(K=%d, mp=%d); degrading to the staged dispatch", K, cfg.mp,
             )
         else:
-            gt = _fused_pairing_product(
-                shard_rows(Ps, mesh), shard_rows(Qs, mesh), mesh
-            )
+            # the dp-boundary padding shard_rows is about to add is the
+            # fused program's occupancy story — record it on the ledger
+            pad = (-B) % cfg.dp
+            with devobs.dispatch(
+                "fused_pairing", rows=B * K, padded_rows=pad * K,
+                dp=cfg.dp, mp=cfg.mp,
+            ):
+                gt = _fused_pairing_product(
+                    shard_rows(Ps, mesh), shard_rows(Qs, mesh), mesh
+                )
             return np.asarray(gt)[:B]
     return pr.pairing_product_staged(Ps, Qs, dp=cfg.dp, mp=cfg.mp)
 

@@ -33,7 +33,7 @@ from ...api.driver import ValidationError
 from ...api.request import TokenRequest
 from ...api.validator import RequestValidator, ValidationResult
 from ...models.token import ID
-from ...utils import faults, profiler, resilience, slo
+from ...utils import devobs, faults, profiler, resilience, slo
 from ...utils import metrics as mx
 from ...utils.tracing import logger, tracer
 from .orderer import (
@@ -234,6 +234,10 @@ class Network:
             # live error-budget state (utils/slo.py): per-SLO burn over
             # the sliding window — the `slo=` column of `ftstop top`
             "slo": slo.ENGINE.health_section(),
+            # device-plane dispatch ledger (utils/devobs.py): per-plane
+            # occupancy and the per-program dispatch/compile forensics
+            # behind `ftstop devices`
+            "device": devobs.health_section(),
         }
 
     # ------------------------------------------------------------ ordering

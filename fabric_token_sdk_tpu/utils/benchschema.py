@@ -68,6 +68,7 @@ OPTIONAL = {
     "state": dict,  # state-plane scale section (validated per field)
     "profile": dict,  # host-path profiler section (validated per field)
     "slo": dict,  # error-budget section (validated per field)
+    "device": dict,  # device-plane dispatch ledger (validated per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
 
@@ -241,6 +242,69 @@ def validate_slo(slo) -> List[str]:
     return problems
 
 
+# the device-plane dispatch ledger section (`device` field, recorded by
+# the headline and soak phases from `utils/devobs.py.section()` and
+# gated by `ftstop compare --device`): total dispatches, batch occupancy
+# (rows / (rows + padding); null until something dispatched), padding
+# waste fraction, dispatch wall-time quantiles, compile/cache forensics,
+# and the per-plane / per-program breakdowns `ftstrace devices` renders
+DEVICE_REQUIRED = {
+    "dispatches": int,
+    "occupancy": _NULLABLE_NUM,
+    "waste_frac": _NULLABLE_NUM,
+    "planes": dict,
+    "programs": dict,
+}
+
+DEVICE_OPTIONAL = {
+    "rows": int,
+    "padded_rows": int,
+    "dispatch_p50_s": _NULLABLE_NUM,
+    "dispatch_p99_s": _NULLABLE_NUM,
+    "compiles": int,
+    "compile_s": _NUM,
+    "cache_hits": int,
+    "cache_misses": int,
+    "degrades": int,
+}
+
+_DEVICE_PLANE_REQUIRED = {
+    "dispatches": int,
+    "rows": int,
+    "padded_rows": int,
+    "occupancy": _NULLABLE_NUM,
+    "waste_frac": _NULLABLE_NUM,
+}
+
+
+def validate_device(device) -> List[str]:
+    """Schema problems of one `device` section (empty list = valid)."""
+    if not isinstance(device, dict):
+        return [f"device is {type(device).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, device, DEVICE_REQUIRED, required=True)
+    _check(problems, device, DEVICE_OPTIONAL, required=False)
+    for frac in ("occupancy", "waste_frac"):
+        v = device.get(frac)
+        if isinstance(v, _NUM) and not isinstance(v, bool) and not (
+            0 <= v <= 1
+        ):
+            problems.append(f"device.{frac}={v} outside [0, 1]")
+    for name, row in (device.get("planes") or {}).items():
+        if not isinstance(row, dict):
+            problems.append(f"device.planes[{name!r}] is {type(row).__name__}")
+            continue
+        rp: List[str] = []
+        _check(rp, row, _DEVICE_PLANE_REQUIRED, required=True)
+        problems.extend(f"device.planes[{name!r}]: {p}" for p in rp)
+    for name, row in (device.get("programs") or {}).items():
+        if not isinstance(row, dict):
+            problems.append(
+                f"device.programs[{name!r}] is {type(row).__name__}"
+            )
+    return problems
+
+
 # one row of the throughput-vs-devices scaling curve (`scaling` field):
 # `n_devices` is the dp x mp mesh extent the block phase ran under,
 # `block_txs_per_s` its measured rate, `efficiency` the per-device
@@ -333,6 +397,8 @@ def validate_result(result) -> List[str]:
         problems.extend(validate_profile(result["profile"]))
     if isinstance(result.get("slo"), dict):
         problems.extend(validate_slo(result["slo"]))
+    if isinstance(result.get("device"), dict):
+        problems.extend(validate_device(result["device"]))
     return problems
 
 

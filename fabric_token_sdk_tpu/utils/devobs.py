@@ -1,0 +1,348 @@
+"""Device-plane dispatch ledger: occupancy, padding waste, and compile
+forensics for every staged XLA dispatch.
+
+The staged execution model buys a tiny, fixed program set by padding
+every batch up to the tile row count — which makes two numbers the
+whole story of device efficiency: how full each tile was (occupancy)
+and how much work was padding (waste). This module is the single place
+those numbers are recorded. Every device entry point
+(`ops/stages.run_rows`, the staged pairing dispatches, and through
+them the batched verifiers/signer/prover) opens a `dispatch(...)`
+frame naming the canonical XLA program it is about to run; the frame
+records requested vs padded rows, dp/mp placement, and wall time, and
+feeds the metrics registry:
+
+  * ``device.dispatch.seconds``            — all dispatches, one histogram
+  * ``device.dispatch.<program>.seconds``  — per-program wall time
+  * ``device.<plane>.occupancy``           — rows / (rows + padding)
+  * ``device.<program>.padded_rows``       — cumulative padding waste
+
+Frames are thread-local, so the `jax.monitoring` compile/cache
+listeners (ops/__init__) can attribute backend compile wall time and
+persistent-cache hits to the program that triggered them — the join
+between XLA's anonymous compile events and `stages.stage_programs()`.
+Degrade decisions (breaker-open skips, dispatch-error fallbacks,
+fused-pairing shape bailouts) land in the same per-program ledger via
+`note_degrade`, so "this program ran slow because it ran on the host"
+is visible next to its occupancy.
+
+Contract (mirrors utils/profiler.py): **zero cost when off**. The
+ledger is on by default (it is pure dict arithmetic on the dispatch
+path — no threads, no sampling); ``FTS_DEVOBS=0`` turns every entry
+point into a passthrough that touches neither the ledger nor the
+metrics registry. On or off, it only observes: verify verdicts and
+committed state are identical either way (tests/test_devobs.py pins
+both properties differentially).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import metrics as mx
+
+__all__ = [
+    "enabled",
+    "dispatch",
+    "plane",
+    "attribute",
+    "current_program",
+    "note_compile",
+    "note_cache",
+    "note_degrade",
+    "snapshot",
+    "reset",
+    "health_section",
+    "section",
+]
+
+UNATTRIBUTED = "(unattributed)"
+DEFAULT_PLANE = "stages"
+
+# occupancy lives in (0, 1]; the default latency buckets would collapse
+# it into two bins
+_OCC_BUCKETS = tuple(i / 10.0 for i in range(1, 11))
+
+_tl = threading.local()
+_lock = threading.Lock()
+# (plane, program) -> aggregate dict
+_programs: Dict[Tuple[str, str], dict] = {}
+# best-effort fallback for compile events fired on sharding worker
+# threads (the dispatch frame lives on the caller's thread)
+_last_frame: Optional[Tuple[str, str]] = None
+
+
+def enabled() -> bool:
+    """Ledger switch; read per entry so tests/operators can flip it."""
+    return os.environ.get("FTS_DEVOBS", "1") != "0"
+
+
+def _entry(frame: Tuple[str, str]) -> dict:
+    e = _programs.get(frame)
+    if e is None:
+        e = _programs[frame] = {
+            "dispatches": 0,
+            "rows": 0,
+            "padded_rows": 0,
+            "wall_s": 0.0,
+            "dp": 1,
+            "mp": 1,
+            "compiles": 0,
+            "compile_s": 0.0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+            "degrades": {},
+        }
+    return e
+
+
+def current_plane() -> str:
+    return getattr(_tl, "plane", None) or DEFAULT_PLANE
+
+
+@contextlib.contextmanager
+def plane(name: str):
+    """Tag dispatches in this block with a logical plane (verify, sign,
+    prove, ...). Passthrough when the ledger is off."""
+    if not enabled():
+        yield
+        return
+    prev = getattr(_tl, "plane", None)
+    _tl.plane = name
+    try:
+        yield
+    finally:
+        _tl.plane = prev
+
+
+@contextlib.contextmanager
+def attribute(program: str, plane_name: Optional[str] = None):
+    """Attribute compile/cache events in this block to `program`
+    WITHOUT recording a dispatch — the warmup precompiler's frame."""
+    if not enabled():
+        yield
+        return
+    global _last_frame
+    frame = (plane_name or current_plane(), program)
+    prev = getattr(_tl, "frame", None)
+    _tl.frame = frame
+    _last_frame = frame
+    try:
+        yield
+    finally:
+        _tl.frame = prev
+
+
+@contextlib.contextmanager
+def dispatch(
+    program: str,
+    *,
+    rows: int,
+    padded_rows: int = 0,
+    dp: int = 1,
+    mp: int = 1,
+    plane: Optional[str] = None,
+):
+    """Record one device dispatch of `program`: requested vs padded
+    rows, dp/mp placement, wall time. Passthrough when off."""
+    if not enabled():
+        yield
+        return
+    global _last_frame
+    pl = plane or current_plane()
+    frame = (pl, program)
+    prev = getattr(_tl, "frame", None)
+    _tl.frame = frame
+    _last_frame = frame
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        wall = time.monotonic() - t0
+        _tl.frame = prev
+        with _lock:
+            e = _entry(frame)
+            e["dispatches"] += 1
+            e["rows"] += rows
+            e["padded_rows"] += padded_rows
+            e["wall_s"] += wall
+            e["dp"] = dp
+            e["mp"] = mp
+        total = rows + padded_rows
+        mx.histogram("device.dispatch.seconds").observe(wall)
+        mx.histogram(f"device.dispatch.{program}.seconds").observe(wall)
+        if total:
+            mx.histogram(
+                f"device.{pl}.occupancy", buckets=_OCC_BUCKETS
+            ).observe(rows / total)
+        if padded_rows:
+            mx.counter(f"device.{program}.padded_rows").inc(padded_rows)
+
+
+def _active_frame() -> Tuple[str, str]:
+    f = getattr(_tl, "frame", None)
+    return f or _last_frame or (DEFAULT_PLANE, UNATTRIBUTED)
+
+
+def current_program() -> Optional[str]:
+    """The program of the innermost dispatch/attribute frame (this
+    thread first, then the process-wide last frame), else None."""
+    f = getattr(_tl, "frame", None) or _last_frame
+    return f[1] if f else None
+
+
+def note_compile(seconds: float) -> None:
+    """Called by the jax.monitoring duration listener: attribute one
+    backend compile's wall time to the active program."""
+    if not enabled():
+        return
+    frame = _active_frame()
+    with _lock:
+        e = _entry(frame)
+        e["compiles"] += 1
+        e["compile_s"] += seconds
+
+
+def note_cache(event: str) -> None:
+    """Called by the jax.monitoring event listener: attribute a
+    persistent-compilation-cache hit/miss to the active program."""
+    if not enabled():
+        return
+    if event.endswith("cache_hits"):
+        key = "cache_hits"
+    elif event.endswith("cache_misses"):
+        key = "cache_misses"
+    else:
+        return
+    frame = _active_frame()
+    with _lock:
+        _entry(frame)[key] += 1
+
+
+def note_degrade(
+    reason: str,
+    program: Optional[str] = None,
+    plane: Optional[str] = None,
+) -> None:
+    """Record a degrade decision (breaker-open skip, dispatch-error
+    fallback, fused-pairing shape bailout) against the active — or
+    explicitly named — program."""
+    if not enabled():
+        return
+    if program is not None:
+        frame = (plane or current_plane(), program)
+    else:
+        frame = _active_frame()
+    with _lock:
+        degrades = _entry(frame)["degrades"]
+        degrades[reason] = degrades.get(reason, 0) + 1
+
+
+def snapshot() -> Dict[Tuple[str, str], dict]:
+    """Raw per-(plane, program) aggregates — for window diffing in
+    tests and bench; values are copies."""
+    with _lock:
+        return {
+            frame: dict(e, degrades=dict(e["degrades"]))
+            for frame, e in _programs.items()
+        }
+
+
+def reset() -> None:
+    """Drop all ledger state (registry metrics are untouched)."""
+    global _last_frame
+    with _lock:
+        _programs.clear()
+    _last_frame = None
+
+
+def _occ(rows: int, padded: int) -> Optional[float]:
+    total = rows + padded
+    return round(rows / total, 4) if total else None
+
+
+def _waste(rows: int, padded: int) -> Optional[float]:
+    total = rows + padded
+    return round(padded / total, 4) if total else None
+
+
+def health_section() -> dict:
+    """The `device` block of `Network.health()` / the `ops.health` RPC:
+    per-plane occupancy plus the full per-program ledger."""
+    snap = snapshot()
+    programs: Dict[str, dict] = {}
+    planes: Dict[str, dict] = {}
+    for (pl, prog), e in sorted(snap.items()):
+        q = mx.REGISTRY.histogram(f"device.dispatch.{prog}.seconds")
+        p50 = q.quantile(0.5)
+        p99 = q.quantile(0.99)
+        programs[f"{pl}:{prog}"] = {
+            "plane": pl,
+            "program": prog,
+            "dispatches": e["dispatches"],
+            "rows": e["rows"],
+            "padded_rows": e["padded_rows"],
+            "occupancy": _occ(e["rows"], e["padded_rows"]),
+            "waste_frac": _waste(e["rows"], e["padded_rows"]),
+            "wall_s": round(e["wall_s"], 6),
+            "p50_s": round(p50, 6) if p50 is not None else None,
+            "p99_s": round(p99, 6) if p99 is not None else None,
+            "dp": e["dp"],
+            "mp": e["mp"],
+            "compiles": e["compiles"],
+            "compile_s": round(e["compile_s"], 3),
+            "cache_hits": e["cache_hits"],
+            "cache_misses": e["cache_misses"],
+            "degrades": sum(e["degrades"].values()),
+            "degrade_reasons": dict(e["degrades"]),
+        }
+        agg = planes.setdefault(
+            pl, {"dispatches": 0, "rows": 0, "padded_rows": 0}
+        )
+        agg["dispatches"] += e["dispatches"]
+        agg["rows"] += e["rows"]
+        agg["padded_rows"] += e["padded_rows"]
+    for agg in planes.values():
+        agg["occupancy"] = _occ(agg["rows"], agg["padded_rows"])
+        agg["waste_frac"] = _waste(agg["rows"], agg["padded_rows"])
+    return {"enabled": enabled(), "planes": planes, "programs": programs}
+
+
+def section() -> dict:
+    """The schema-validated `device` section of a bench result
+    (utils/benchschema.py): top-level scalars the `ftstop compare
+    --device` gate reads, plus the per-plane / per-program breakdown."""
+    h = health_section()
+    rows = sum(e["rows"] for e in h["programs"].values())
+    padded = sum(e["padded_rows"] for e in h["programs"].values())
+    agg = mx.REGISTRY.histogram("device.dispatch.seconds")
+    p50 = agg.quantile(0.5)
+    p99 = agg.quantile(0.99)
+    return {
+        "dispatches": sum(
+            e["dispatches"] for e in h["programs"].values()
+        ),
+        "rows": rows,
+        "padded_rows": padded,
+        "occupancy": _occ(rows, padded),
+        "waste_frac": _waste(rows, padded),
+        "dispatch_p50_s": round(p50, 6) if p50 is not None else None,
+        "dispatch_p99_s": round(p99, 6) if p99 is not None else None,
+        "compiles": sum(e["compiles"] for e in h["programs"].values()),
+        "compile_s": round(
+            sum(e["compile_s"] for e in h["programs"].values()), 3
+        ),
+        "cache_hits": sum(
+            e["cache_hits"] for e in h["programs"].values()
+        ),
+        "cache_misses": sum(
+            e["cache_misses"] for e in h["programs"].values()
+        ),
+        "degrades": sum(e["degrades"] for e in h["programs"].values()),
+        "planes": h["planes"],
+        "programs": h["programs"],
+    }

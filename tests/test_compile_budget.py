@@ -93,6 +93,52 @@ def test_stage_rows_program_count_is_batch_invariant(rng):
     )
 
 
+def test_dispatch_ledger_pins_program_set_across_batch_sweep(rng):
+    """The dispatch ledger is the witness for the compile-budget story:
+    sweeping batch size across the msm row runner must grow DISPATCHES
+    but never the set of distinct (plane, program) frames — one
+    canonical tile program per plane, whatever the batch size. This is
+    the same invariant `_compiles()` pins from the XLA side, asserted
+    from the ledger side."""
+    from fabric_token_sdk_tpu.utils import devobs
+
+    bases = [hm.g1_mul(hm.G1_GEN, 31 + i) for i in range(3)]
+    table = cv.FixedBaseTable(bases)
+
+    def scal(B):
+        return np.stack(
+            [cv.encode_scalars([rng.randrange(hm.R) for _ in range(3)])
+             for _ in range(B)]
+        )
+
+    before = devobs.snapshot()
+    sweep = (1, 3, 11, 32)
+    for B in sweep:
+        st.g1_msm_rows(table.flat, scal(B))
+    after = devobs.snapshot()
+
+    def disp(snap, frame):
+        return snap.get(frame, {}).get("dispatches", 0)
+
+    grown = {f for f in after if disp(after, f) > disp(before, f)}
+    # the whole sweep lands on ONE frame: the stages plane, the one
+    # canonical 3-base msm tile program
+    assert grown == {("stages", "g1_msm3_tile")}, grown
+    frame = ("stages", "g1_msm3_tile")
+    assert disp(after, frame) - disp(before, frame) == len(sweep)
+    rows = after[frame]["rows"] - before.get(frame, {}).get("rows", 0)
+    padded = after[frame]["padded_rows"] - before.get(frame, {}).get(
+        "padded_rows", 0
+    )
+    assert rows == sum(sweep)
+    assert padded == sum((-B) % st.ROW_TILE for B in sweep)
+    # and the sweep compiled at most the one tile program (0 when an
+    # earlier test already compiled it), never one per batch size
+    assert after[frame]["compiles"] - before.get(frame, {}).get(
+        "compiles", 0
+    ) <= 1
+
+
 def test_wf_verifier_is_transfer_shape_invariant(rng, pp):
     """The staged BatchedWFVerifier must compile ZERO new programs for a
     second, differently-shaped (n_in, n_out) block — the guarantee the

@@ -1,0 +1,247 @@
+"""Device-plane dispatch ledger (`utils/devobs.py`): zero-cost-when-off,
+occupancy/waste arithmetic, per-program compile/cache attribution, and
+the differential no-perturbation contract.
+
+The ledger is an observer with the same contract as the host-path
+profiler: ``FTS_DEVOBS=0`` must make every entry point an inert
+passthrough (no ledger state, no registry writes, no threads), and on
+or off the accept/reject verdicts of an identical workload must not
+change.
+"""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto import hostmath as hm
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.ops import curve as cv, stages as st
+from fabric_token_sdk_tpu.services.network import BlockPolicy, Network, TxStatus
+from fabric_token_sdk_tpu.services.ttx import Party, Transaction
+from fabric_token_sdk_tpu.utils import benchschema, devobs
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    """Each test sees (and leaves) a reset ledger; registry histograms
+    are process-wide and asserted by delta only."""
+    devobs.reset()
+    yield
+    devobs.reset()
+
+
+# ===================================================================
+# zero cost when off
+# ===================================================================
+
+
+def _hist_count(name):
+    h = mx.REGISTRY.snapshot().get("histograms", {}).get(name)
+    return h["count"] if h else 0
+
+
+def test_off_is_passthrough(monkeypatch):
+    monkeypatch.setenv("FTS_DEVOBS", "0")
+    assert not devobs.enabled()
+    agg_before = _hist_count("device.dispatch.seconds")
+    threads_before = threading.active_count()
+    with devobs.plane("verify"):
+        with devobs.attribute("offtest_attr"):
+            with devobs.dispatch("offtest_prog", rows=5, padded_rows=3):
+                pass
+    devobs.note_compile(1.0)
+    devobs.note_cache("/jax/compilation_cache/cache_hits")
+    devobs.note_degrade("offtest_reason")
+    # no ledger state, no per-program registry metrics, no threads
+    assert devobs.snapshot() == {}
+    assert devobs.current_program() is None
+    snap = mx.REGISTRY.snapshot()
+    assert "device.dispatch.offtest_prog.seconds" not in snap.get(
+        "histograms", {}
+    )
+    assert "device.offtest_prog.padded_rows" not in snap.get("counters", {})
+    assert _hist_count("device.dispatch.seconds") == agg_before
+    assert threading.active_count() == threads_before
+    # off means off for the surfaced sections too
+    assert devobs.health_section()["enabled"] is False
+    assert devobs.health_section()["programs"] == {}
+
+
+# ===================================================================
+# ledger arithmetic + schema
+# ===================================================================
+
+
+def test_dispatch_records_occupancy_waste_and_placement():
+    agg_before = _hist_count("device.dispatch.seconds")
+    with devobs.plane("verify"):
+        with devobs.dispatch("ledger_prog", rows=5, padded_rows=3, dp=2):
+            pass
+    snap = devobs.snapshot()
+    assert set(snap) == {("verify", "ledger_prog")}
+    e = snap[("verify", "ledger_prog")]
+    assert e["dispatches"] == 1
+    assert (e["rows"], e["padded_rows"], e["dp"], e["mp"]) == (5, 3, 2, 1)
+    assert e["wall_s"] >= 0
+
+    h = devobs.health_section()
+    prog = h["programs"]["verify:ledger_prog"]
+    assert prog["occupancy"] == 0.625
+    assert prog["waste_frac"] == 0.375
+    assert h["planes"]["verify"]["occupancy"] == 0.625
+
+    # the registry got the histograms + the padding-waste counter
+    assert _hist_count("device.dispatch.seconds") == agg_before + 1
+    assert _hist_count("device.dispatch.ledger_prog.seconds") >= 1
+    reg = mx.REGISTRY.snapshot()
+    assert reg["counters"]["device.ledger_prog.padded_rows"] == 3
+
+    # the bench `device` section validates against the shared schema
+    section = devobs.section()
+    assert benchschema.validate_device(section) == []
+    assert section["dispatches"] == 1
+    assert section["occupancy"] == 0.625
+    assert section["waste_frac"] == 0.375
+
+
+def test_compile_and_cache_attribution():
+    with devobs.dispatch("attr_prog", rows=1):
+        devobs.note_compile(0.25)
+        devobs.note_cache("/jax/compilation_cache/cache_hits")
+        devobs.note_cache("/jax/compilation_cache/cache_misses")
+        assert devobs.current_program() == "attr_prog"
+    # the frame outlives the block as the process-wide fallback (compiles
+    # fired on sharding worker threads land on the last program)
+    devobs.note_compile(0.25)
+    e = devobs.snapshot()[(devobs.DEFAULT_PLANE, "attr_prog")]
+    assert e["compiles"] == 2
+    assert e["compile_s"] == pytest.approx(0.5)
+    assert (e["cache_hits"], e["cache_misses"]) == (1, 1)
+
+    # with no frame ever opened, events land on the unattributed bucket
+    devobs.reset()
+    devobs.note_compile(0.1)
+    devobs.note_cache("/jax/compilation_cache/cache_hits")
+    assert set(devobs.snapshot()) == {
+        (devobs.DEFAULT_PLANE, devobs.UNATTRIBUTED)
+    }
+
+    # attribute() joins warmup's AOT loop to the ledger without faking a
+    # dispatch
+    devobs.reset()
+    with devobs.attribute("warm_prog"):
+        devobs.note_compile(0.2)
+    e = devobs.snapshot()[(devobs.DEFAULT_PLANE, "warm_prog")]
+    assert (e["dispatches"], e["compiles"]) == (0, 1)
+
+
+def test_note_degrade_lands_on_named_program():
+    devobs.note_degrade("k_not_divisible", program="fused_pairing")
+    devobs.note_degrade("k_not_divisible", program="fused_pairing")
+    e = devobs.snapshot()[(devobs.DEFAULT_PLANE, "fused_pairing")]
+    assert e["degrades"] == {"k_not_divisible": 2}
+    prog = devobs.health_section()["programs"]["stages:fused_pairing"]
+    assert prog["degrades"] == 2
+    assert prog["degrade_reasons"] == {"k_not_divisible": 2}
+
+
+# ===================================================================
+# a real staged dispatch lands in the ledger with the canonical name
+# ===================================================================
+
+
+def test_msm_dispatch_ledgered_with_canonical_program_name():
+    rng = random.Random(0xD0B5)
+    base = [hm.g1_mul(hm.G1_GEN, 3)]
+    table = cv.FixedBaseTable(base)
+    scalars = np.stack(
+        [cv.encode_scalars([rng.randrange(hm.R)]) for _ in range(5)]
+    )
+    st.g1_msm_rows(table.flat, scalars)
+    frame = ("stages", "g1_msm1_tile")
+    e = devobs.snapshot()[frame]
+    assert e["dispatches"] == 1
+    assert e["rows"] == 5
+    # run_rows pads the 5-row batch up to the ROW_TILE slab
+    assert e["padded_rows"] == (-5) % st.ROW_TILE
+    prog = devobs.health_section()["programs"]["stages:g1_msm1_tile"]
+    assert prog["occupancy"] == pytest.approx(5 / (5 + (-5) % st.ROW_TILE))
+
+
+# ===================================================================
+# clamp-site attribution (satellite: _clamp_mp no longer drops `where`)
+# ===================================================================
+
+
+def test_clamp_site_is_attributed():
+    from fabric_token_sdk_tpu.parallel import sharding
+
+    before = mx.REGISTRY.snapshot().get("counters", {})
+    cfg = sharding.MeshConfig.build(6, 4)
+    assert cfg.mp == 3  # largest divisor of 6 that fits
+    after = mx.REGISTRY.snapshot()["counters"]
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    # the aggregate stays (tests/test_parallel.py pins its delta), the
+    # site now rides a per-site counter AND a reasoned flight event
+    assert delta("sharding.clamped") == 1
+    assert delta("sharding.clamped.meshconfig") == 1
+    evt = [e for e in mx.FLIGHT.tail(50) if e["kind"] == "sharding.clamped"][-1]
+    assert evt["where"] == "MeshConfig"
+    assert (evt["want"], evt["got"], evt["n_devices"]) == (4, 3, 6)
+
+
+# ===================================================================
+# differential: the ledger never perturbs verdicts
+# ===================================================================
+
+
+def _run_scenario():
+    """Deterministic mixed-verdict workload (the profiler's scenario):
+    issue, then two transfers of which the second double-spends."""
+    pp = FabTokenPublicParams()
+    network = Network(
+        RequestValidator(FabTokenDriver(pp)),
+        policy=BlockPolicy(max_block_txs=8),
+    )
+    parties = {
+        name: Party(name, FabTokenDriver(pp), network)
+        for name in ("issuer-node", "alice-node", "bob-node")
+    }
+    parties["issuer-node"].new_issuer_wallet("issuer")
+    alice = parties["alice-node"].new_owner_wallet("alice", anonymous=False)
+    bob = parties["bob-node"].new_owner_wallet("bob", anonymous=False)
+    tx = Transaction(parties["issuer-node"], "devobs-seed")
+    tx.issue("issuer", "USD", [5], [alice.recipient_identity()],
+             anonymous=False)
+    tx.collect_endorsements(None)
+    tx.submit()
+    alice_p = parties["alice-node"]
+    tid = alice_p.vault.token_ids()[0]
+
+    def spend(anchor):
+        req = alice_p.tms.new_request(anchor)
+        tokens, metas = alice_p.vault.get_many([tid])
+        alice_p.tms.add_transfer(
+            req, [tid], tokens, metas, "USD", [5],
+            [bob.recipient_identity()],
+        )
+        alice_p.tms.sign_transfers(req)
+        return req.to_bytes()
+
+    events = network.submit_many([spend("dv-ok"), spend("dv-dup")])
+    return [e.status for e in events]
+
+
+def test_ledger_never_perturbs_verdicts(monkeypatch):
+    monkeypatch.setenv("FTS_DEVOBS", "1")
+    on_statuses = _run_scenario()
+    assert on_statuses == [TxStatus.VALID, TxStatus.INVALID]
+    monkeypatch.setenv("FTS_DEVOBS", "0")
+    off_statuses = _run_scenario()
+    assert off_statuses == on_statuses

@@ -215,6 +215,51 @@ def test_profiler_and_slo_names_pinned_both_ways():
     )
 
 
+def test_device_ledger_names_pinned_both_ways():
+    """The dispatch-ledger PR's names cannot drift in either direction:
+    the aggregate + per-program dispatch histograms, the per-plane
+    occupancy histogram, the padding-waste counter, the clamp-site
+    counters and the degrade flight kinds must be emitted by the code
+    AND documented; the `FTS_DEVOBS` switch the code reads must appear
+    in the doc's switches table."""
+    emitted, corpus = _emitted()
+    emitted_names = {name for _kind, name in emitted}
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    exact, prefixes = _doc_names(doc)
+
+    # aggregate dispatch histogram: exact name, both ways
+    assert ("histogram", "device.dispatch.seconds") in emitted
+    assert "device.dispatch.seconds" in exact
+
+    # f-string families: emitted as prefixes, documented as
+    # `<placeholder>`-style prefixes
+    for prefix in ("device.dispatch.", "device."):
+        assert prefix in emitted_names, f"{prefix}* no longer emitted"
+        assert prefix in prefixes, f"{prefix}* undocumented"
+    for token in ("device.dispatch.<program>.seconds",
+                  "device.<plane>.occupancy",
+                  "device.<program>.padded_rows",
+                  "sharding.clamped.<where>"):
+        assert f"`{token}`" in doc, f"{token} undocumented"
+
+    # clamp-site counter family + breaker-skip counter, both ways
+    assert ("counter", "sharding.clamped.") in emitted
+    assert "sharding.clamped." in prefixes
+    assert ("counter", "sharding.breaker_skips") in emitted
+    assert "sharding.breaker_skips" in exact
+
+    # degrade decisions are reasoned flight events, in the taxonomy
+    doc_flight = _doc_flight_kinds(doc)
+    for kind in ("sharding.fallback", "sharding.clamped"):
+        assert ("flight", kind) in emitted, f"{kind} no longer emitted"
+        assert kind in doc_flight, f"{kind} missing from flight taxonomy"
+
+    # the ledger switch, both ways
+    assert '"FTS_DEVOBS"' in corpus, "code no longer reads FTS_DEVOBS"
+    assert "`FTS_DEVOBS`" in doc, "FTS_DEVOBS missing from switches table"
+
+
 def _wire_ops():
     """Every RPC op name `LedgerServer._dispatch_op` handles (the live
     wire protocol, ops plane included)."""
