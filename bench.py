@@ -737,6 +737,26 @@ def _soak(hb, zk_pp=None) -> dict:
     }
     hv_before = mx.REGISTRY.histogram("ledger.block.host_validate.seconds").sum
     commit_before = mx.REGISTRY.histogram("ledger.block.commit.seconds").sum
+    # batch-first host-path accounting (the `host` section): parse-cache
+    # counters and hostbatch.* row counters, plus the per-block batch-pass
+    # wall histograms — all as window deltas
+    host_counter_names = (
+        "request.cache.hits", "request.cache.misses",
+        "parse.cache.hits", "parse.cache.misses",
+        "hostbatch.sign.rows", "hostbatch.proof.rows",
+        "hostbatch.conservation.rows",
+    )
+    host_before = {
+        n: mx.REGISTRY.counter(n).value for n in host_counter_names
+    }
+    host_batch_hists = (
+        "ledger.block.host_sign_batch.seconds",
+        "ledger.block.host_proof_batch.seconds",
+        "ledger.block.host_conservation.seconds",
+    )
+    host_batch_before = {
+        n: mx.REGISTRY.histogram(n).sum for n in host_batch_hists
+    }
     # resilience accounting over the soak window: breaker trips, chaos
     # fault counts, and which planes saw at least one host fallback
     # (one counter per device plane — the single source for both the
@@ -1006,6 +1026,62 @@ def _soak(hb, zk_pp=None) -> dict:
         ),
         "stacks": stacks,
         "dropped_stacks": int(mx.REGISTRY.counter("prof.dropped").value),
+    }
+    # batch-first host-validation section (`host` field, schema
+    # `benchschema.HOST_*`, gated by `ftstop compare --host`): the
+    # scalar tail per leg (exclusive seconds — what the block-level
+    # batch passes did NOT absorb), per-block leg p99s, the batch-pass
+    # wall + row deltas, and parse-cache effectiveness
+    from fabric_token_sdk_tpu.services.network import pipeline as npipe
+
+    host_delta = {
+        n: int(mx.REGISTRY.counter(n).value - before)
+        for n, before in host_before.items()
+    }
+    req_lookups = (
+        host_delta["request.cache.hits"] + host_delta["request.cache.misses"]
+    )
+    parse_lookups = (
+        host_delta["parse.cache.hits"] + host_delta["parse.cache.misses"]
+    )
+
+    def _leg_p99(leg):
+        q = mx.REGISTRY.histogram(f"ledger.host.{leg}.seconds").quantile(0.99)
+        return round(q, 6) if q is not None else None
+
+    soak["host"] = {
+        "unmarshal_s": legs_delta["unmarshal"],
+        "fiat_shamir_s": legs_delta["fiat_shamir"],
+        "sig_verify_s": legs_delta["sig_verify"],
+        "conservation_s": legs_delta["conservation"],
+        "input_match_s": legs_delta["input_match"],
+        "host_validate_frac": soak["host_validate_frac"],
+        "unmarshal_p99_s": _leg_p99("unmarshal"),
+        "fiat_shamir_p99_s": _leg_p99("fiat_shamir"),
+        "sign_batch_s": round(
+            mx.REGISTRY.histogram(host_batch_hists[0]).sum
+            - host_batch_before[host_batch_hists[0]], 6
+        ),
+        "proof_batch_s": round(
+            mx.REGISTRY.histogram(host_batch_hists[1]).sum
+            - host_batch_before[host_batch_hists[1]], 6
+        ),
+        "conservation_batch_s": round(
+            mx.REGISTRY.histogram(host_batch_hists[2]).sum
+            - host_batch_before[host_batch_hists[2]], 6
+        ),
+        "sign_batch_rows": host_delta["hostbatch.sign.rows"],
+        "proof_batch_rows": host_delta["hostbatch.proof.rows"],
+        "conservation_rows": host_delta["hostbatch.conservation.rows"],
+        "request_cache_hit_rate": (
+            round(host_delta["request.cache.hits"] / req_lookups, 4)
+            if req_lookups else None
+        ),
+        "parse_cache_hit_rate": (
+            round(host_delta["parse.cache.hits"] / parse_lookups, 4)
+            if parse_lookups else None
+        ),
+        "workers": npipe.host_workers(),
     }
     # SLO verdict over the soak window (engine was reset at soak start,
     # so the sliding window saw only soak traffic)
@@ -1506,7 +1582,7 @@ def main() -> None:
                 # profile/slo ride inside the soak dict so direct _soak
                 # callers (tests) see them; in the recorded result they
                 # are schema-validated top-level sections of their own
-                for section in ("profile", "slo", "device"):
+                for section in ("profile", "slo", "device", "host"):
                     if section in soak:
                         result[section] = soak.pop(section)
                 result["soak"] = soak

@@ -10,6 +10,7 @@ Usage:
     python cmd/ftstop.py compare --history BENCH_history.jsonl --state
     python cmd/ftstop.py compare --history BENCH_history.jsonl --slo
     python cmd/ftstop.py compare --history BENCH_history.jsonl --device
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --host
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
@@ -577,6 +578,46 @@ def compare_device(args) -> int:
     )
 
 
+def host_of(result: dict) -> Optional[dict]:
+    """The `host` section of one schema-valid bench result, or None.
+    (Callers filter through `validate_result` first, which already
+    field-checks any dict-typed host section.)"""
+    s = result.get("host")
+    return s if isinstance(s, dict) else None
+
+
+# (host field, direction): +1 = higher is better, -1 = lower is better
+HOST_METRICS = (
+    ("host_validate_frac", -1),
+    ("unmarshal_p99_s", -1),
+    ("fiat_shamir_p99_s", -1),
+)
+
+
+def compare_host(args) -> int:
+    """The host-path observatory: gate the batch-first host validation
+    numbers — the host leg's fraction of block commit wall and the
+    per-block unmarshal / fiat_shamir p99s regress when they GROW —
+    against the per-metric MEDIAN of the prior host-carrying history
+    rounds (same contract as `--scaling`/`--soak`/`--device`)."""
+    return _gate_sections(
+        args, "host", host_of, HOST_METRICS,
+        lambda s: (
+            f"host path, latest round: "
+            f"host_validate_frac={s.get('host_validate_frac')} "
+            f"unmarshal={s['unmarshal_s']:g}s "
+            f"fiat_shamir={s['fiat_shamir_s']:g}s "
+            f"sig_verify={s['sig_verify_s']:g}s "
+            f"batch_rows={s.get('sign_batch_rows', 0)}/"
+            f"{s.get('proof_batch_rows', 0)}/"
+            f"{s.get('conservation_rows', 0)} "
+            f"req_cache={s.get('request_cache_hit_rate')} "
+            f"parse_cache={s.get('parse_cache_hit_rate')} "
+            f"workers={s.get('workers', '-')}"
+        ),
+    )
+
+
 def compare_slo(args) -> int:
     """The SLO gate: unlike the regression observatories (which diff
     against prior rounds), this is an ABSOLUTE verdict on the latest
@@ -775,6 +816,11 @@ def main(argv=None) -> int:
                              "occupancy (drop), padding waste and p99 "
                              "dispatch wall (growth) vs the median of prior "
                              "device-carrying rounds (history mode only)")
+    p_gate.add_argument("--host", action="store_true",
+                        help="gate on the batch-first host path: host-leg "
+                             "fraction of commit wall and unmarshal / "
+                             "fiat_shamir p99 (growth) vs the median of "
+                             "prior host-carrying rounds (history mode only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
@@ -804,6 +850,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --device needs --history")
         return compare_device(args)
+    if args.host:
+        if not args.history:
+            ap.error("compare --host needs --history")
+        return compare_host(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

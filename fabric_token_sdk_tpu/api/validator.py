@@ -51,7 +51,8 @@ class RequestValidator:
     def validate(self, request: TokenRequest, resolve_input: Callable[[ID], bytes],
                  now=None,
                  transfer_proofs: Optional[Dict[int, bool]] = None,
-                 sig_verified: Optional[Dict[tuple, tuple]] = None) -> ValidationResult:
+                 sig_verified: Optional[Dict[tuple, tuple]] = None,
+                 conservation: Optional[Dict[int, bool]] = None) -> ValidationResult:
         """`now`: deterministic commit timestamp for time-locked scripts.
 
         `transfer_proofs`: verdicts from the block-batched proof plane,
@@ -65,6 +66,14 @@ class RequestValidator:
         `{obligation_key: (identity_bytes, bool)}` (see the module
         docstring). Only `pk`-kind obligations ever get verdicts;
         nym/htlc identities always host-verify.
+
+        `conservation`: True-only verdicts from the block-level
+        vectorized conservation pass, keyed by transfer-record index —
+        True means the driver's `validate_conservation_many` hook already
+        proved the action's type/value checks over the very bytes the
+        input_match leg pins to ledger state, so the driver skips its
+        per-tx conservation arithmetic. Records without a verdict (and
+        every failure) run the full scalar checks.
         """
         result = ValidationResult()
         payload = request.marshal_to_sign()
@@ -134,6 +143,13 @@ class RequestValidator:
                 # decorated driver would mask a binding TypeError as
                 # ValidationError, so there is no post-hoc fallback)
                 kwargs["sig_verified"] = rec_sigs
+            cv = conservation.get(idx) if conservation else None
+            if cv is True:
+                # same SPI opt-in as sig_verified: a verdict only exists
+                # when THIS driver's validate_conservation_many hook
+                # emitted it, so the kwarg is only bound for drivers that
+                # declared it (True-only — failures carry no verdict)
+                kwargs["conservation_verified"] = True
             spent, outputs = self.driver.validate_transfer(
                 rec.action, resolve_input, payload, rec.signatures, **kwargs
             )

@@ -672,6 +672,23 @@ def _install_native() -> None:
         if _nb.pairing_product([(G1_GEN, G2_GEN), (g1_neg(G1_GEN), G2_GEN)]) != FP12_ONE:
             _selfcheck_fail("pairing_product")  # pragma: no cover
             return  # pragma: no cover
+        # The batch entry points (`g1_mul_batch` / `g1_multiexp_rows`) are
+        # the host validation fast path for Schnorr/WF verification — a
+        # miscompile confined to the batch loops (distinct C code from the
+        # scalar entry) must fail the swap-in too.
+        if _nb.g1_mul_batch([G1_GEN, g1_neg(G1_GEN)], [12345, 54321]) != [
+            g1_mul_py(G1_GEN, 12345), g1_mul_py(g1_neg(G1_GEN), 54321)
+        ]:
+            _selfcheck_fail("g1_mul_batch")  # pragma: no cover
+            return  # pragma: no cover
+        if _nb.g1_multiexp_rows(
+            [[G1_GEN, g1_neg(G1_GEN)], [G1_GEN, G1_GEN]], [[3, 5], [7, 11]]
+        ) != [
+            g1_multiexp_py([G1_GEN, g1_neg(G1_GEN)], [3, 5]),
+            g1_multiexp_py([G1_GEN, G1_GEN], [7, 11]),
+        ]:
+            _selfcheck_fail("g1_multiexp_rows")  # pragma: no cover
+            return  # pragma: no cover
     except Exception as e:  # pragma: no cover
         _selfcheck_fail(f"exception: {e}")
         return
@@ -730,6 +747,43 @@ def g1_mul_batch(points, scalars):
     return [g1_mul_py(p, k) for p, k in zip(points, scalars)]
 
 
+def g1_multiexp_rows(points_rows, scalar_rows):
+    """One multiexp per row; same-width runs collapse into single native
+    calls (the C kernel requires rectangular input), pure-Python multiexp
+    per row otherwise. Rows may be ragged — grouping happens here so
+    callers batch heterogeneous Schnorr statements in one shot."""
+    points_rows = [list(r) for r in points_rows]
+    scalar_rows = [list(r) for r in scalar_rows]
+    if len(points_rows) != len(scalar_rows):
+        raise ValueError(
+            f"multiexp_rows length mismatch: {len(points_rows)} != {len(scalar_rows)}"
+        )
+    for pr, sr in zip(points_rows, scalar_rows):
+        if len(pr) != len(sr):
+            raise ValueError("multiexp_rows: row length mismatch")
+    from ..utils import metrics as _mx
+
+    if not NATIVE_G1:
+        _mx.counter("hostmath.g1_multiexp_rows.python").inc()
+        return [g1_multiexp_py(p, s) for p, s in zip(points_rows, scalar_rows)]
+    from ..native import bn254py as _nb
+
+    _mx.counter("hostmath.g1_multiexp_rows.native").inc()
+    out = [None] * len(points_rows)
+    widths = {}
+    for i, pr in enumerate(points_rows):
+        widths.setdefault(len(pr), []).append(i)
+    for width, idxs in widths.items():
+        if width == 0:
+            continue  # multiexp over nothing is the identity (None)
+        res = _nb.g1_multiexp_rows(
+            [points_rows[i] for i in idxs], [scalar_rows[i] for i in idxs]
+        )
+        for i, pt in zip(idxs, res):
+            out[i] = pt
+    return out
+
+
 # ---------------------------------------------------------------- hashing
 
 def hash_to_zr(data: bytes, domain: bytes = b"fts-tpu/zr") -> int:
@@ -740,6 +794,33 @@ def hash_to_zr(data: bytes, domain: bytes = b"fts-tpu/zr") -> int:
     h0 = hashlib.sha256(domain + b"\x00" + data).digest()
     h1 = hashlib.sha256(domain + b"\x01" + data).digest()
     return int.from_bytes(h0 + h1, "big") % R
+
+
+def hash_to_zr_many(items) -> list:
+    """Block-level batch Fiat-Shamir: `hash_to_zr` over many (data, domain)
+    pairs with ONE `native.sha256_batch` dispatch (fastser offsets buffer)
+    instead of 2N per-proof hashlib round trips.
+
+    Byte-identical to `[hash_to_zr(d, dom) for d, dom in items]` by
+    construction — the two-block expansion messages are laid out in
+    transcript order and hashed by the same primitive; `sha256_many`
+    falls back to hashlib scalar hashing when no native library builds
+    (differential-pinned in tests/test_host_batch.py, native on and off).
+    """
+    items = list(items)
+    if not items:
+        return []
+    msgs = []
+    for data, domain in items:
+        msgs.append(domain + b"\x00" + data)
+        msgs.append(domain + b"\x01" + data)
+    from ..native import sha256_many
+
+    digests = sha256_many(msgs, force_native=True)
+    return [
+        int.from_bytes(digests[2 * i] + digests[2 * i + 1], "big") % R
+        for i in range(len(items))
+    ]
 
 
 def hash_to_g1(data: bytes, domain: bytes = b"fts-tpu/g1"):

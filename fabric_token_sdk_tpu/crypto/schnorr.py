@@ -43,3 +43,24 @@ def recompute_commitment(bases: Sequence, proof: SchnorrProof):
 def commit_randomness(bases: Sequence, randomness: Sequence[int]):
     """Prover side: commitment to fresh randomness."""
     return hm.g1_multiexp(list(bases[: len(randomness)]), list(randomness))
+
+
+def recompute_commitments(bases_rows: Sequence[Sequence],
+                          proofs: Sequence[SchnorrProof]) -> List:
+    """Batch `recompute_commitment` over many proofs.
+
+    Each proof folds into ONE multiexp row — (bases..., statement) against
+    (responses..., -challenge), the statement negation riding the scalar —
+    which is the same group element the scalar helper assembles from
+    multiexp + add. All rows then go down in single native dispatches via
+    `hm.g1_multiexp_rows` instead of one ctypes round trip per proof.
+    """
+    if len(bases_rows) != len(proofs):
+        raise ValueError("schnorr: bases/proofs length mismatch")
+    rows_p, rows_s = [], []
+    for bases, proof in zip(bases_rows, proofs):
+        if len(proof.responses) > len(bases):
+            raise ValueError("schnorr: more responses than bases")
+        rows_p.append(list(bases[: len(proof.responses)]) + [proof.statement])
+        rows_s.append(list(proof.responses) + [(-proof.challenge) % hm.R])
+    return hm.g1_multiexp_rows(rows_p, rows_s)

@@ -71,4 +71,51 @@ def challenge(pk_point, com, message: bytes) -> int:
     return hm.hash_to_zr(message + g1s_bytes([pk_point, com]), b"fts/schnorr-sig")
 
 
+def verify_many(rows):
+    """Host-batched Schnorr verification over (pk_point, message, sig_raw)
+    rows — the row format the block sign collector emits.
+
+    Two block-wide dispatches replace 2N scalar ctypes round trips and 2N
+    hashlib calls: one `hm.g1_multiexp_rows` recomputes every response
+    commitment (each row is (g, pk) x (z, -c), the exact
+    `response_commitment` algebra) and one `hm.hash_to_zr_many`
+    recomputes every challenge. Returns one entry per row: True (valid),
+    False (challenge mismatch) or None (signature this batch could not
+    evaluate — the scalar path owns the precise error). Challenges are
+    byte-identical to `PublicKey.verify` by construction
+    (differential-pinned in tests/test_host_batch.py).
+    """
+    rows = list(rows)
+    out = [None] * len(rows)
+    parsed = []  # (row index, pk_point, message, chal, resp)
+    for i, (pk_point, message, sig_raw) in enumerate(rows):
+        try:
+            d = loads(sig_raw)
+            chal, resp = d["c"], d["z"]
+            if not isinstance(chal, int) or not isinstance(resp, int):
+                raise ValueError("non-integer signature fields")
+        except Exception:
+            continue
+        parsed.append((i, pk_point, message, chal, resp))
+    if not parsed:
+        return out
+    coms = hm.g1_multiexp_rows(
+        [[hm.G1_GEN, pk] for _i, pk, _m, _c, _z in parsed],
+        [[resp, -chal % hm.R] for _i, _pk, _m, chal, resp in parsed],
+    )
+    transcripts = []  # (row index, expected chal) aligned with transcripts
+    keep = []
+    for (i, pk, message, chal, _z), com in zip(parsed, coms):
+        try:
+            transcripts.append(
+                (message + g1s_bytes([pk, com]), b"fts/schnorr-sig")
+            )
+            keep.append((i, chal))
+        except Exception:
+            continue  # un-encodable commitment: scalar path reports it
+    for (i, chal), got in zip(keep, hm.hash_to_zr_many(transcripts)):
+        out[i] = got == chal
+    return out
+
+
 _challenge = challenge  # backwards-compatible private alias

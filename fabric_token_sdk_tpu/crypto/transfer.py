@@ -220,3 +220,33 @@ class TransferVerifier:
                 if proof.range_correctness is None:
                     raise ValueError("invalid transfer proof: missing range proof")
                 self.range_verifier.verify(proof.range_correctness)
+
+
+def verify_transfer_proofs(specs, pp: PublicParams) -> List[Optional[bool]]:
+    """Host-batched transfer proof verification.
+
+    `specs` are (inputs, outputs, raw_proof) triples. Only range-skipped
+    shapes (1-in/1-out ownership transfers, the shape that dominates
+    traffic) are batch-decidable — for those the WF challenge compare IS
+    the whole accept/reject decision, so a True here is exactly a
+    `TransferVerifier.verify` accept. Shapes that carry a range proof, and
+    proofs the batch cannot parse, return None: degrade-only, the scalar
+    verifier re-runs them and owns the precise error.
+    """
+    specs = list(specs)
+    out: List[Optional[bool]] = [None] * len(specs)
+    wf_specs, idxs = [], []
+    for i, (inputs, outputs, raw) in enumerate(specs):
+        if not _skip_range(len(inputs), len(outputs)):
+            continue
+        try:
+            proof = TransferProof.from_bytes(raw)
+        except Exception:
+            continue
+        wf_specs.append((inputs, outputs, proof.wf))
+        idxs.append(i)
+    if not wf_specs:
+        return out
+    for i, v in zip(idxs, wf.verify_transfer_wfs(pp.ped_params, wf_specs)):
+        out[i] = v
+    return out

@@ -195,6 +195,65 @@ class TransferWFVerifier:
             raise ValueError("invalid transfer well-formedness proof")
 
 
+def verify_transfer_wfs(ped_params, specs) -> List[Optional[bool]]:
+    """Block-level transfer WF verification.
+
+    `specs` are (inputs, outputs, raw_wf) triples — one per proof left to
+    the host. Every proof's Schnorr commitment recomputation collapses
+    into batched multiexp rows (`schnorr.recompute_commitments`) and every
+    Fiat-Shamir challenge into ONE `hm.hash_to_zr_many` dispatch, instead
+    of per-proof ctypes/hashlib round trips.
+
+    Returns one entry per spec: True (challenge matches — byte-identical
+    to `TransferWFVerifier.verify` accepting), False (challenge mismatch)
+    or None (proof this batch could not evaluate). Degrade-only contract:
+    callers treat anything but True as "re-verify on the scalar path",
+    which owns the precise error message.
+    """
+    pp = list(ped_params)
+    specs = list(specs)
+    out: List[Optional[bool]] = [None] * len(specs)
+    proofs: List[schnorr.SchnorrProof] = []
+    # (spec index, wf, inputs, outputs, com slice start) per parsable spec
+    plans = []
+    for i, (inputs, outputs, raw) in enumerate(specs):
+        try:
+            wf = TransferWF.from_bytes(raw)
+            start = len(proofs)
+            proofs += _side_proofs(
+                list(inputs), wf.input_values, wf.input_bfs,
+                wf.type_resp, wf.sum_resp, wf.challenge,
+            )
+            proofs += _side_proofs(
+                list(outputs), wf.output_values, wf.output_bfs,
+                wf.type_resp, wf.sum_resp, wf.challenge,
+            )
+        except Exception:
+            continue
+        plans.append((i, wf, list(inputs), list(outputs), start))
+    if not plans:
+        return out
+    coms = schnorr.recompute_commitments([pp] * len(proofs), proofs)
+    transcripts = []
+    keep = []  # (spec index, expected challenge) aligned with transcripts
+    for i, wf, inputs, outputs, start in plans:
+        n_in, n_out = len(inputs), len(outputs)
+        in_coms = coms[start : start + n_in + 1]
+        out_coms = coms[start + n_in + 1 : start + n_in + n_out + 2]
+        try:
+            raw = g1s_bytes(
+                in_coms[:-1], [in_coms[-1]], out_coms[:-1], [out_coms[-1]],
+                inputs, outputs,
+            )
+        except Exception:
+            continue  # un-encodable commitment: scalar path reports it
+        transcripts.append((raw, b"fts/transfer-wf"))
+        keep.append((i, wf.challenge))
+    for (i, expected), got in zip(keep, hm.hash_to_zr_many(transcripts)):
+        out[i] = got == expected
+    return out
+
+
 # ===================================================================
 # Issue well-formedness
 # ===================================================================

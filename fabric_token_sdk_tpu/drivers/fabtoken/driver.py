@@ -12,13 +12,18 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ...api.driver import Driver, IssueOutcome, TransferOutcome, ValidationError, vguard
-from ...crypto.serialization import dumps, loads
+from ...crypto.serialization import BytesCache, dumps, loads, loads_cached
 from ...models.quantity import Quantity
 from ...models.token import ID, Owner, Token, UnspentToken
 from ...utils import profiler
 from .. import identity
 
 MAX_PRECISION = 64
+
+# Bounded read-only decode cache: chained transfers spend the previous
+# tx's outputs, so the same token bytes decode as an output in block N
+# and an input in block N+1 (and again in every plan hook).
+_TOKENS = BytesCache(Token.from_bytes)
 
 
 @dataclass
@@ -111,7 +116,7 @@ class FabTokenDriver(Driver):
     @vguard
     def validate_issue(self, action_bytes: bytes):
         with profiler.leg("conservation"):
-            d = loads(action_bytes)
+            d = loads_cached(action_bytes)
             outputs = d["outputs"]
             if not outputs:
                 raise ValidationError("issue must have at least one output")
@@ -120,7 +125,7 @@ class FabTokenDriver(Driver):
                 raise ValidationError("issuer is not authorized")
             token_type = None
             for raw in outputs:
-                t = Token.from_bytes(raw)
+                t = _TOKENS.lookup(raw)
                 q = t.quantity_as(self.pp.quantity_precision)
                 if q.is_zero():
                     raise ValidationError("issue output with zero value")
@@ -134,11 +139,11 @@ class FabTokenDriver(Driver):
     @vguard
     def validate_transfer(self, action_bytes, resolve_input, signed_payload,
                           signatures, now=None, proof_verified=None,
-                          sig_verified=None):
+                          sig_verified=None, conservation_verified=None):
         # fabtoken carries no ZK proof: `transfer_batch_plan` never emits
         # a plan, so `proof_verified` is always None here and ignored
         with profiler.leg("input_match"):
-            d = loads(action_bytes)
+            d = loads_cached(action_bytes)
             ids = [ID(t, i) for t, i in d["ids"]]
             if not ids:
                 raise ValidationError("transfer must have at least one input")
@@ -149,21 +154,26 @@ class FabTokenDriver(Driver):
                     "transfer inputs do not match ledger state"
                 )
         with profiler.leg("conservation"):
-            inputs = [Token.from_bytes(raw) for raw in ledger_inputs]
-            outputs = [Token.from_bytes(raw) for raw in d["outputs"]]
-            types = {t.type for t in inputs} | {t.type for t in outputs}
-            if len(types) != 1:
-                raise ValidationError(
-                    f"tokens must have the same type, got {sorted(types)}"
-                )
-            p = self.pp.quantity_precision
-            in_sum = sum(t.quantity_as(p).value for t in inputs)
-            out_sum = sum(t.quantity_as(p).value for t in outputs)
-            if in_sum != out_sum:
-                raise ValidationError(
-                    f"transfer does not preserve value: "
-                    f"in={in_sum} out={out_sum}"
-                )
+            inputs = [_TOKENS.lookup(raw) for raw in ledger_inputs]
+            if conservation_verified is not True:
+                # no block-level verdict for this action: full scalar
+                # checks (the batch pass covered the ACTION-claimed
+                # inputs, which the input_match leg above just pinned to
+                # ledger state, and the same output bytes)
+                outputs = [_TOKENS.lookup(raw) for raw in d["outputs"]]
+                types = {t.type for t in inputs} | {t.type for t in outputs}
+                if len(types) != 1:
+                    raise ValidationError(
+                        f"tokens must have the same type, got {sorted(types)}"
+                    )
+                p = self.pp.quantity_precision
+                in_sum = sum(t.quantity_as(p).value for t in inputs)
+                out_sum = sum(t.quantity_as(p).value for t in outputs)
+                if in_sum != out_sum:
+                    raise ValidationError(
+                        f"transfer does not preserve value: "
+                        f"in={in_sum} out={out_sum}"
+                    )
         if len(signatures) != len(inputs):
             raise ValidationError("one signature per input owner required")
         for si, (t, sig) in enumerate(zip(inputs, signatures)):
@@ -191,8 +201,8 @@ class FabTokenDriver(Driver):
         required signature. Malformed bytes return None (host path
         rejects them with the precise error)."""
         try:
-            d = loads(action_bytes)
-            owners = [Token.from_bytes(raw).owner.raw for raw in d["inputs"]]
+            d = loads_cached(action_bytes)
+            owners = [_TOKENS.lookup(raw).owner.raw for raw in d["inputs"]]
             return owners or None
         except Exception:
             return None
@@ -201,20 +211,72 @@ class FabTokenDriver(Driver):
         """Signature-plane hook: fabtoken issues always require the
         action-named issuer's signature."""
         try:
-            issuer = loads(action_bytes)["issuer"]
+            issuer = loads_cached(action_bytes)["issuer"]
             return issuer if isinstance(issuer, bytes) and issuer else None
         except Exception:
             return None
 
+    def validate_conservation_many(self, actions) -> List[Optional[bool]]:
+        """Block-level vectorized conservation over transfer actions.
+
+        Every action's tokens decode into one flat column (bounded parse
+        cache: chained transfers make the same bytes recur), type/value
+        columns are computed in a single pass, and each verdict falls out
+        of segment sums instead of a per-tx parse/sum loop.
+
+        A True verdict is decisive for exactly the checks the per-tx
+        conservation leg performs — uniform type and value preservation
+        over the ACTION-claimed inputs and outputs (the per-tx input_match
+        leg separately pins claimed inputs to ledger state before the
+        verdict is consumed). Anything else returns None: degrade-only,
+        the scalar path re-checks and owns the precise error.
+        """
+        actions = list(actions)
+        out: List[Optional[bool]] = [None] * len(actions)
+        plans = []  # (action index, column start, n_in, n_out)
+        flat: List[bytes] = []
+        for i, raw in enumerate(actions):
+            try:
+                d = loads_cached(raw)
+                ins, outs = d["inputs"], d["outputs"]
+                if not isinstance(ins, list) or not isinstance(outs, list):
+                    continue
+                if not ins or not outs:
+                    continue
+            except Exception:
+                continue
+            plans.append((i, len(flat), len(ins), len(outs)))
+            flat.extend(ins)
+            flat.extend(outs)
+        if not plans:
+            return out
+        p = self.pp.quantity_precision
+        cols: List[Optional[tuple]] = []
+        for raw in flat:
+            try:
+                t = _TOKENS.lookup(raw)
+                cols.append((t.type, t.quantity_as(p).value))
+            except Exception:
+                cols.append(None)  # malformed token: scalar path reports
+        for i, start, n_in, n_out in plans:
+            seg = cols[start : start + n_in + n_out]
+            if any(c is None for c in seg):
+                continue
+            if len({c[0] for c in seg}) != 1:
+                continue
+            if sum(c[1] for c in seg[:n_in]) == sum(c[1] for c in seg[n_in:]):
+                out[i] = True
+        return out
+
     # ------------------------------------------------------------ tokens
 
     def output_to_unspent(self, token_id, output_bytes, metadata_bytes=None) -> UnspentToken:
-        t = Token.from_bytes(output_bytes)
+        t = _TOKENS.lookup(output_bytes)
         q = t.quantity_as(self.pp.quantity_precision)
         return UnspentToken(token_id, t.owner, t.type, q.decimal())
 
     def output_owner(self, output_bytes: bytes) -> bytes:
-        return Token.from_bytes(output_bytes).owner.raw
+        return _TOKENS.lookup(output_bytes).owner.raw
 
     def verify_owner_signature(self, owner_identity, message, signature) -> None:
         identity.verify_signature(owner_identity, message, signature)

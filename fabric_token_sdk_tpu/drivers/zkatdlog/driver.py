@@ -13,12 +13,17 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from ...api.driver import Driver, IssueOutcome, TransferOutcome, ValidationError, vguard
 from ...crypto import hostmath as hm, issue as issue_mod, transfer as transfer_mod
-from ...crypto.serialization import dumps, loads
+from ...crypto.serialization import BytesCache, dumps, loads, loads_cached
 from ...crypto.setup import PublicParams
 from ...crypto.token import Metadata, Token as ZkToken, TokenDataWitness, token_in_the_clear, tokens_with_witness
 from ...models.token import ID, Owner, UnspentToken
 from ...utils import profiler
 from .. import identity
+
+# Bounded read-only decode cache: chained transfers spend the previous
+# tx's outputs, so the same commitment bytes decode repeatedly across
+# plan hooks and validation legs.
+_ZTOKENS = BytesCache(ZkToken.from_bytes)
 
 
 class ZKATDLogDriver(Driver):
@@ -146,8 +151,8 @@ class ZKATDLogDriver(Driver):
 
     @vguard
     def validate_issue(self, action_bytes: bytes):
-        d = loads(action_bytes)
-        outputs = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+        d = loads_cached(action_bytes)
+        outputs = [_ZTOKENS.lookup(raw) for raw in d["outputs"]]
         if not outputs:
             raise ValidationError("issue must have at least one output")
         anonymous = d["anon"]
@@ -172,7 +177,7 @@ class ZKATDLogDriver(Driver):
                           signatures, now=None, proof_verified=None,
                           sig_verified=None):
         with profiler.leg("input_match"):
-            d = loads(action_bytes)
+            d = loads_cached(action_bytes)
             ids = [ID(t, i) for t, i in d["ids"]]
             if not ids:
                 raise ValidationError("transfer must have at least one input")
@@ -182,8 +187,8 @@ class ZKATDLogDriver(Driver):
                     "transfer inputs do not match ledger state"
                 )
         with profiler.leg("conservation"):
-            in_tokens = [ZkToken.from_bytes(raw) for raw in ledger_inputs]
-            out_tokens = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+            in_tokens = [_ZTOKENS.lookup(raw) for raw in ledger_inputs]
+            out_tokens = [_ZTOKENS.lookup(raw) for raw in d["outputs"]]
         if proof_verified is False:
             raise ValidationError("invalid transfer proof")
         if proof_verified is None:
@@ -233,9 +238,9 @@ class ZKATDLogDriver(Driver):
         `TransferVerifier` check. Malformed bytes return None and fall to
         the host path (which rejects them with the precise error)."""
         try:
-            d = loads(action_bytes)
-            in_tokens = [ZkToken.from_bytes(raw) for raw in d["inputs"]]
-            out_tokens = [ZkToken.from_bytes(raw) for raw in d["outputs"]]
+            d = loads_cached(action_bytes)
+            in_tokens = [_ZTOKENS.lookup(raw) for raw in d["inputs"]]
+            out_tokens = [_ZTOKENS.lookup(raw) for raw in d["outputs"]]
             proof = d["proof"]
             if not in_tokens or not out_tokens or not isinstance(proof, bytes):
                 return None
@@ -248,6 +253,16 @@ class ZKATDLogDriver(Driver):
         except Exception:
             return None
 
+    def transfer_host_batch(self, rows) -> List[Optional[bool]]:
+        """Host-batched proof plane: `rows` are the (input_points,
+        output_points, proof_bytes) tuples `transfer_batch_plan` emits for
+        groups the device plane did not take. Verified in bulk via
+        `transfer_mod.verify_transfer_proofs` — batched commitment
+        multiexps plus ONE block-level Fiat-Shamir hash dispatch. True
+        verdicts only are decisive; False/None rows fall back to the
+        scalar `TransferVerifier`, which owns the precise error."""
+        return transfer_mod.verify_transfer_proofs(list(rows), self.pp)
+
     def transfer_sign_plan(self, action_bytes: bytes):
         """Signature-plane hook: the ACTION-claimed input owners, one per
         required signature (`validate_transfer` pins claimed inputs to
@@ -255,8 +270,8 @@ class ZKATDLogDriver(Driver):
         kinds (nym, htlc) survive here — the pipeline's collector routes
         them host when the identity cache yields no public key."""
         try:
-            d = loads(action_bytes)
-            owners = [ZkToken.from_bytes(raw).owner for raw in d["inputs"]]
+            d = loads_cached(action_bytes)
+            owners = [_ZTOKENS.lookup(raw).owner for raw in d["inputs"]]
             return owners or None
         except Exception:
             return None
@@ -265,7 +280,7 @@ class ZKATDLogDriver(Driver):
         """Signature-plane hook: non-anonymous issues carry the named
         issuer's signature; anonymous issues need none."""
         try:
-            d = loads(action_bytes)
+            d = loads_cached(action_bytes)
             if d["anon"]:
                 return None
             issuer = d["issuer"]
@@ -314,7 +329,7 @@ class ZKATDLogDriver(Driver):
         return UnspentToken(token_id, Owner(owner), token_type, str(value))
 
     def output_owner(self, output_bytes: bytes) -> bytes:
-        return ZkToken.from_bytes(output_bytes).owner
+        return _ZTOKENS.lookup(output_bytes).owner
 
     def verify_owner_signature(self, owner_identity, message, signature) -> None:
         identity.verify_signature(

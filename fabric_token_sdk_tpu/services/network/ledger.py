@@ -238,6 +238,34 @@ class Network:
             # occupancy and the per-program dispatch/compile forensics
             # behind `ftstop devices`
             "device": devobs.health_section(),
+            # host-path parse caches (identity / parsed-request / raw
+            # bytes): lifetime hit/miss counters — a cold or thrashing
+            # cache shows up here before it shows up as host-leg wall
+            "caches": self._caches_section(),
+        }
+
+    @staticmethod
+    def _caches_section() -> dict:
+        from ...api import request as request_mod
+
+        def _c(name: str) -> int:
+            return mx.REGISTRY.counter(name).value
+
+        return {
+            "identity": {
+                "hits": _c("identity.cache.hits"),
+                "misses": _c("identity.cache.misses"),
+            },
+            "request": {
+                "entries": request_mod.cache_len(),
+                "hits": _c("request.cache.hits"),
+                "misses": _c("request.cache.misses"),
+                "evictions": _c("request.cache.evictions"),
+            },
+            "parse": {
+                "hits": _c("parse.cache.hits"),
+                "misses": _c("parse.cache.misses"),
+            },
         }
 
     # ------------------------------------------------------------ ordering
@@ -355,15 +383,30 @@ class Network:
         timings: dict = {}
         fresh, _dups = self._split_fresh(subs, resolve_known=False)
         requests = [s.request for s in fresh]
-        verdicts = self._pipeline.proof_verdicts(requests, timings)
+        host_pv: Dict[int, Dict[int, bool]] = {}
+        verdicts = self._pipeline.proof_verdicts(
+            requests, timings, host_verdicts=host_pv
+        )
         # the batched signature plane is state-independent too (payloads
         # and identities come from request bytes), so it overlaps the
         # previous block's commit exactly like the proof plane
         sig_verdicts = self._pipeline.sign_verdicts(requests, timings)
+        # block-level vectorized conservation: also state-independent
+        # (it checks the ACTION-claimed bytes; the per-tx input_match leg
+        # pins them to ledger state before any verdict is consumed)
+        cons_verdicts = self._pipeline.conservation_verdicts(
+            requests, timings
+        )
         return {
             "verdicts": {id(fresh[ti]): v for ti, v in verdicts.items()},
             "sig_verdicts": {
                 id(fresh[ti]): v for ti, v in sig_verdicts.items()
+            },
+            "cons_verdicts": {
+                id(fresh[ti]): v for ti, v in cons_verdicts.items()
+            },
+            "host_verdicts": {
+                id(fresh[ti]): v for ti, v in host_pv.items()
             },
             "timings": timings,
             "cut_mono": cut_mono,
@@ -439,8 +482,14 @@ class Network:
             # consistent pre-block state until the atomic merge below.
             if pre is None:
                 timings: dict = {}
-                verdicts = self._pipeline.proof_verdicts(requests, timings)
+                host_pv: Dict[int, Dict[int, bool]] = {}
+                verdicts = self._pipeline.proof_verdicts(
+                    requests, timings, host_verdicts=host_pv
+                )
                 sig_verdicts = self._pipeline.sign_verdicts(requests, timings)
+                cons_verdicts = self._pipeline.conservation_verdicts(
+                    requests, timings
+                )
             else:
                 # stage A already verified this block (overlapping the
                 # previous block's commit): adopt its verdicts by
@@ -461,6 +510,16 @@ class Network:
                     ti: psv[id(s)]
                     for ti, s in enumerate(fresh) if id(s) in psv
                 }
+                pcv = pre.get("cons_verdicts") or {}
+                cons_verdicts = {
+                    ti: pcv[id(s)]
+                    for ti, s in enumerate(fresh) if id(s) in pcv
+                }
+                phv = pre.get("host_verdicts") or {}
+                host_pv = {
+                    ti: phv[id(s)]
+                    for ti, s in enumerate(fresh) if id(s) in phv
+                }
             commit_time = time.time()
             view = _BlockView(self._state, self._spent)
             events: List[FinalityEvent] = []
@@ -470,12 +529,17 @@ class Network:
             # host_validate_s into the named `ledger.host.*` legs
             with profiler.collect() as host_legs:
                 for ti, request in enumerate(requests):
+                    # device verdicts (True/False) win over the host
+                    # batch's True-only rows; the two sets are disjoint
+                    # by construction (host rows are device leftovers)
+                    dv, hv = verdicts.get(ti), host_pv.get(ti)
+                    proofs = {**hv, **dv} if (dv and hv) else (dv or hv)
                     # per-tx validation runs under the TX's trace, not
                     # the committing thread's — whoever wins the race
                     with mx.use_trace(fresh[ti].trace):
                         event = self._validate_tx(
-                            request, view, commit_time, verdicts.get(ti),
-                            sig_verdicts.get(ti),
+                            request, view, commit_time, proofs,
+                            sig_verdicts.get(ti), cons_verdicts.get(ti),
                         )
                     if fresh[ti].trace is not None:
                         event.trace_id = fresh[ti].trace.trace_id
@@ -521,6 +585,18 @@ class Network:
                 "grouping_s": round(timings.get("grouping_s", 0.0), 6),
                 "device_verify_s": round(timings.get("device_verify_s", 0.0), 6),
                 "sign_verify_s": round(timings.get("sign_verify_s", 0.0), 6),
+                # batch-first host passes (FTS_HOST_BATCH): block-level
+                # sign / proof / conservation work hoisted out of the
+                # per-tx loop — their wall is NOT in host_validate_s
+                "host_sign_batch_s": round(
+                    timings.get("host_sign_batch_s", 0.0), 6
+                ),
+                "host_proof_batch_s": round(
+                    timings.get("host_proof_batch_s", 0.0), 6
+                ),
+                "host_conservation_batch_s": round(
+                    timings.get("host_conservation_batch_s", 0.0), 6
+                ),
                 "host_validate_s": round(host_validate_s, 6),
                 "wal_s": round(wal_s, 6),
                 "merge_s": round(merge_s, 6),
@@ -540,6 +616,21 @@ class Network:
                 host_validate_s
             )
             mx.histogram("ledger.block.merge.seconds").observe(merge_s)
+            # per-block wall of the batch-first host passes (zero-valued
+            # blocks skipped: the quantiles should describe blocks that
+            # actually ran a pass)
+            if timings.get("host_sign_batch_s", 0.0) > 0:
+                mx.histogram("ledger.block.host_sign_batch.seconds").observe(
+                    timings["host_sign_batch_s"]
+                )
+            if timings.get("host_proof_batch_s", 0.0) > 0:
+                mx.histogram("ledger.block.host_proof_batch.seconds").observe(
+                    timings["host_proof_batch_s"]
+                )
+            if timings.get("host_conservation_batch_s", 0.0) > 0:
+                mx.histogram(
+                    "ledger.block.host_conservation.seconds"
+                ).observe(timings["host_conservation_batch_s"])
             # whole-block commit latency, always on (the quantiles the
             # live ops plane serves), plus the breakdown `ops.health`
             # reports for the LAST committed block
@@ -593,13 +684,15 @@ class Network:
     def _validate_tx(self, request: TokenRequest, view: _BlockView,
                      commit_time: float,
                      proofs: Optional[Dict[int, bool]],
-                     sigs: Optional[Dict[tuple, tuple]] = None) -> FinalityEvent:
+                     sigs: Optional[Dict[tuple, tuple]] = None,
+                     cons: Optional[Dict[int, bool]] = None) -> FinalityEvent:
         tx_id = request.anchor
         try:
             with mx.span("network.validate", tx=tx_id):
                 result = self.validator.validate(
                     request, view.resolve, now=commit_time,
                     transfer_proofs=proofs, sig_verified=sigs,
+                    conservation=cons,
                 )
             view.apply(tx_id, result)
             mx.counter("network.tx.valid").inc()
@@ -649,7 +742,10 @@ class Network:
             {
                 "height": len(self._blocks),
                 "ts": commit_time,
-                "requests": [r.to_bytes() for r in requests],
+                # wire_bytes: the exact bytes each request was parsed
+                # from when unmodified since (skips a full re-serialize
+                # on this hot path); replay decodes both forms identically
+                "requests": [r.wire_bytes() for r in requests],
                 "txs": [
                     [e.tx_id, e.status.value, e.message]
                     for e in events if not e.transient

@@ -52,6 +52,17 @@ from ...utils import metrics as mx
 from ...utils.tracing import logger
 
 
+def host_batch_enabled() -> bool:
+    """Master switch for the batch-first HOST validation passes
+    (`FTS_HOST_BATCH`, default on): block-level Fiat-Shamir + native
+    batch multiply for signatures/proofs the device plane left behind,
+    and the vectorized conservation pass. `0` restores the exact per-tx
+    scalar path — the differential baseline. All host batch passes are
+    degrade-only: they emit True-only verdicts, and every None/False row
+    falls back to the scalar check that owns the precise error message."""
+    return os.environ.get("FTS_HOST_BATCH", "1") != "0"
+
+
 class Backpressure(RuntimeError):
     """The ordering queue is at `BlockPolicy.queue_max` capacity: the
     submission was rejected BEFORE entering ordering, so a retry (with
@@ -428,11 +439,22 @@ class BlockValidationPipeline:
     def proof_verdicts(
         self, requests: Sequence[TokenRequest],
         timings: Optional[dict] = None,
+        host_verdicts: Optional[Dict[int, Dict[int, bool]]] = None,
     ) -> Dict[int, Dict[int, bool]]:
         """`timings`, when passed, is filled with the critical-path
         split of this call: `grouping_s` (plan + same-shape grouping)
         and `device_verify_s` (time inside batched verify calls,
-        including failed ones that degraded to host)."""
+        including failed ones that degraded to host).
+
+        `host_verdicts`, when passed as a dict, receives True-only
+        verdicts from the batch-first HOST pass over every row the
+        device plane left behind (`_host_proof_batch`). They are kept
+        OUT of the returned device verdicts so the
+        `ledger.validate.batched/host` accounting (and every fallback
+        counter) still describes the device plane alone; the ledger
+        merges the two maps only when handing verdicts to the per-tx
+        validator. `None` (the default) skips the host pass — direct
+        callers see the exact device-only behavior."""
         if timings is None:
             timings = {}
         timings.setdefault("grouping_s", 0.0)
@@ -456,10 +478,18 @@ class BlockValidationPipeline:
 
         verdicts: Dict[int, Dict[int, bool]] = {}
         verifier = None
+        # rows the device plane leaves behind (small groups, open
+        # breaker, failed/timed-out dispatches, no device plane at all):
+        # the batch-first HOST pass below still verifies them in one
+        # native multiexp + one block-level Fiat-Shamir call before the
+        # per-tx scalar loop sees them
+        leftovers: List[Tuple[int, int, tuple]] = []
+        device_dead = False
         brk = resilience.breaker("verify")
         deadline_s = resilience.device_deadline_s("verify")
         for shape, rows in sorted(groups.items()):
-            if len(rows) < max(1, self.policy.min_batch):
+            if device_dead or len(rows) < max(1, self.policy.min_batch):
+                leftovers.extend(rows)
                 continue
             if not brk.allow():
                 # open breaker: instant host fallback — no deadline paid,
@@ -469,6 +499,7 @@ class BlockValidationPipeline:
                     "verify.host_fallback", shape=str(shape),
                     txs=len(rows), reason="breaker_open",
                 )
+                leftovers.extend(rows)
                 continue
             if verifier is None:
                 try:
@@ -485,13 +516,17 @@ class BlockValidationPipeline:
                     brk.record_failure()
                     mx.counter("ledger.block.batch_errors").inc()
                     mx.flight("verify.host_fallback", reason="construct")
-                    return verdicts
+                    device_dead = True
+                    leftovers.extend(rows)
+                    continue
                 if verifier is None:
                     # the driver HAS no batched plane: neither success
                     # nor failure — release the admission (else a
                     # half-open probe would stay consumed forever)
                     brk.cancel_probe()
-                    return verdicts
+                    device_dead = True
+                    leftovers.extend(rows)
+                    continue
 
             def _device_verify(rows=rows):
                 # device-plane fault point: firing here (INSIDE the
@@ -518,6 +553,7 @@ class BlockValidationPipeline:
                     "verify.host_fallback", shape=str(shape),
                     txs=len(rows), reason="timeout",
                 )
+                leftovers.extend(rows)
                 continue
             except Exception:
                 # the host plane re-verifies these rows; never fail a block
@@ -527,6 +563,7 @@ class BlockValidationPipeline:
                 mx.flight(
                     "verify.host_fallback", shape=str(shape), txs=len(rows)
                 )
+                leftovers.extend(rows)
                 continue
             finally:
                 timings["device_verify_s"] += time.monotonic() - tg
@@ -537,7 +574,52 @@ class BlockValidationPipeline:
             )
             for (ti, ri, _), good in zip(rows, ok):
                 verdicts.setdefault(ti, {})[ri] = bool(good)
+        if host_verdicts is not None:
+            self._host_proof_batch(leftovers, host_verdicts, timings)
         return verdicts
+
+    def _host_proof_batch(
+        self, rows: List[Tuple[int, int, tuple]],
+        verdicts: Dict[int, Dict[int, bool]], timings: dict,
+    ) -> None:
+        """Batch-first HOST pass over transfer rows the device plane left
+        behind: the driver's `transfer_host_batch` hook recomputes every
+        proof's commitments in one native multiexp call and derives all
+        Fiat-Shamir challenges in one block-level sha256 batch
+        (`hostmath.hash_to_zr_many`). True-only: a True verdict skips the
+        per-tx scalar proof check; None/False rows (undecidable shapes,
+        malformed bytes, failed proofs) fall through to the scalar path
+        that owns the precise error. An exception here degrades to the
+        scalar path wholesale — accept/reject can never depend on it."""
+        timings.setdefault("host_proof_batch_s", 0.0)
+        if not rows or not host_batch_enabled():
+            return
+        hook = getattr(self.validator.driver, "transfer_host_batch", None)
+        if hook is None:
+            return
+        from .pipeline import host_map
+
+        t0 = time.monotonic()
+        try:
+            try:
+                oks = host_map(hook, [row for _, _, row in rows])
+            except Exception:
+                logger.exception(
+                    "host proof batch failed; scalar path verifies"
+                )
+                return
+            batched = 0
+            for (ti, ri, _), good in zip(rows, oks):
+                if good is True:
+                    batched += 1
+                    verdicts.setdefault(ti, {})[ri] = True
+            if batched:
+                mx.counter("hostbatch.proof.rows").inc(batched)
+                mx.flight(
+                    "verify.host_batch", rows=len(rows), verified=batched
+                )
+        finally:
+            timings["host_proof_batch_s"] += time.monotonic() - t0
 
     # ------------------------------------------------------ signature plane
 
@@ -648,7 +730,10 @@ class BlockValidationPipeline:
             timings = {}
         timings.setdefault("sign_verify_s", 0.0)
         if not self.sign_enabled():
-            return {}
+            # device plane off (CPU auto / forced host): the batch-first
+            # HOST pass still folds every pk obligation of the block into
+            # one native multiexp + one Fiat-Shamir sha256 batch
+            return self._host_sign_batch(requests, timings)
         brk = resilience.breaker("sign")
         if brk.rejecting():
             # open breaker (cooldown running): skip even the collection —
@@ -741,3 +826,109 @@ class BlockValidationPipeline:
             ok=sum(1 for v in verdicts if v),
         )
         return out
+
+    def _host_sign_batch(
+        self, requests: Sequence[TokenRequest], timings: dict,
+    ) -> Dict[int, Dict[tuple, tuple]]:
+        """Batch-first HOST signature pass — the block's pk obligations
+        verified via `crypto.sign.verify_many`: ONE native bn254 batch
+        multiexp recomputes every Schnorr commitment and ONE block-level
+        sha256 batch (`hostmath.hash_to_zr_many`) derives every
+        Fiat-Shamir challenge, fanned over the commit-host worker pool
+        (`FTS_COMMIT_WORKERS`). True-only verdicts: rows that fail or
+        don't parse get NO verdict and fall to the per-obligation scalar
+        loop, which owns the precise error message — accept/reject can
+        never depend on this pass. Shares the device plane's obligation
+        collector, so statement pinning (`identity_bytes` echoed with
+        each verdict) is identical."""
+        timings.setdefault("host_sign_batch_s", 0.0)
+        if not host_batch_enabled():
+            return {}
+        t0 = time.monotonic()
+        try:
+            rows, keys, host = self._collect_sign_obligations(requests)
+            if host:
+                mx.counter("batch.sign.host").inc(host)
+            if not rows:
+                return {}
+            try:
+                from ...crypto import sign as sign_mod
+                from .pipeline import host_map
+
+                oks = host_map(sign_mod.verify_many, rows)
+            except Exception:
+                mx.counter("batch.sign.host").inc(len(rows))
+                logger.exception(
+                    "host sign batch failed; block signatures scalar-verify"
+                )
+                return {}
+            out: Dict[int, Dict[tuple, tuple]] = {}
+            batched = 0
+            for (ti, okey, ident), v in zip(keys, oks):
+                if v is not True:
+                    # None (unparseable blob) or False (bad signature):
+                    # the scalar loop re-verifies and reports precisely
+                    mx.counter("batch.sign.host").inc()
+                    continue
+                batched += 1
+                out.setdefault(ti, {})[okey] = (ident, True)
+            if batched:
+                mx.counter("hostbatch.sign.rows").inc(batched)
+                mx.flight(
+                    "sign.host_batch", rows=len(rows), verified=batched
+                )
+            return out
+        finally:
+            timings["host_sign_batch_s"] += time.monotonic() - t0
+
+    # ------------------------------------------------------ conservation
+
+    def conservation_verdicts(
+        self, requests: Sequence[TokenRequest],
+        timings: Optional[dict] = None,
+    ) -> Dict[int, Dict[int, bool]]:
+        """Block-level vectorized conservation/type checks: every
+        transfer action's tokens decode into one flat column and the
+        per-action verdicts fall out of segment sums
+        (`driver.validate_conservation_many`). True-only, keyed
+        `{tx_index: {record_index: True}}` for
+        `RequestValidator.validate(conservation=...)` — an action with
+        no verdict runs the full scalar arithmetic, so the pass can only
+        make blocks faster, never change accept/reject."""
+        if timings is None:
+            timings = {}
+        timings.setdefault("host_conservation_batch_s", 0.0)
+        if not host_batch_enabled():
+            return {}
+        hook = getattr(
+            self.validator.driver, "validate_conservation_many", None
+        )
+        if hook is None:
+            return {}
+        t0 = time.monotonic()
+        try:
+            actions, keys = [], []
+            for ti, req in enumerate(requests):
+                for ri, rec in enumerate(req.transfers):
+                    actions.append(rec.action)
+                    keys.append((ti, ri))
+            if not actions:
+                return {}
+            try:
+                oks = hook(actions)
+            except Exception:
+                logger.exception(
+                    "conservation batch failed; scalar checks run per tx"
+                )
+                return {}
+            out: Dict[int, Dict[int, bool]] = {}
+            batched = 0
+            for (ti, ri), good in zip(keys, oks):
+                if good is True:
+                    batched += 1
+                    out.setdefault(ti, {})[ri] = True
+            if batched:
+                mx.counter("hostbatch.conservation.rows").inc(batched)
+            return out
+        finally:
+            timings["host_conservation_batch_s"] += time.monotonic() - t0

@@ -61,14 +61,80 @@ can never depend on the overlap.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional
 
 from ...utils import metrics as mx
 from ...utils import profiler
 from ...utils.tracing import logger
+
+
+# ------------------------------------------------------------ host workers
+#
+# Shared worker pool for the batch-first HOST validation passes
+# (`BlockValidationPipeline._host_sign_batch` / `_host_proof_batch`):
+# the native bn254/sha256 calls release the GIL, so chunking one block's
+# rows across a few threads overlaps their C time. WAL append and vault
+# merge stay single-threaded on the stage-B worker — this pool only ever
+# computes pure verdicts over immutable row tuples.
+
+_HOST_MIN_CHUNK = 8
+
+_host_pool: Optional[ThreadPoolExecutor] = None
+_host_pool_size = 0
+_host_pool_lock = threading.Lock()
+
+
+def host_workers() -> int:
+    """Resolved `FTS_COMMIT_WORKERS`: unset/0 = auto (half the cores,
+    capped at 4 — host batch rows only parallelize inside the GIL-free
+    native calls, beyond that threads just contend), 1 = inline, N = N
+    pool threads."""
+    try:
+        n = int(os.environ.get("FTS_COMMIT_WORKERS", "0"))
+    except ValueError:
+        n = 0
+    if n <= 0:
+        n = min(4, max(1, (os.cpu_count() or 2) // 2))
+    return n
+
+
+def host_map(fn: Callable[[List], List], items) -> List:
+    """Fan `fn` (chunk -> aligned verdict list) over `items` on the
+    shared commit-host pool, preserving order. Small batches (or a
+    1-worker pool) run inline — the pool must never cost more than the
+    loop it replaces. A chunk exception propagates to the caller, which
+    owns the degrade-to-scalar decision."""
+    items = list(items)
+    n = host_workers()
+    if n <= 1 or len(items) < 2 * _HOST_MIN_CHUNK:
+        return list(fn(items))
+    global _host_pool, _host_pool_size
+    with _host_pool_lock:
+        if _host_pool is None or _host_pool_size != n:
+            if _host_pool is not None:
+                _host_pool.shutdown(wait=False)
+            _host_pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="fts-commit-host",
+                initializer=profiler.set_thread_role,
+                initargs=("commit-worker",),
+            )
+            _host_pool_size = n
+        pool = _host_pool
+    n_chunks = min(n, len(items) // _HOST_MIN_CHUNK)
+    size = (len(items) + n_chunks - 1) // n_chunks
+    futs = [
+        pool.submit(fn, items[i : i + size])
+        for i in range(0, len(items), size)
+    ]
+    out: List = []
+    for f in futs:
+        out.extend(f.result())
+    return out
 
 
 class BusyClock:

@@ -69,6 +69,7 @@ OPTIONAL = {
     "profile": dict,  # host-path profiler section (validated per field)
     "slo": dict,  # error-budget section (validated per field)
     "device": dict,  # device-plane dispatch ledger (validated per field)
+    "host": dict,  # batch-first host-validation section (per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
 
@@ -123,6 +124,63 @@ def validate_soak(soak) -> List[str]:
     if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
         problems.append("soak.steady_txs_per_s is negative")
     return problems
+
+# the batch-first host-validation section (`host` field, recorded by
+# the soak phase and gated by `ftstop compare --host`): per-leg
+# EXCLUSIVE seconds the sub-leg timers collected over the soak window
+# (the scalar tail after the block-level batch passes), the host-leg
+# fraction of block commit wall, and the batch-pass/cache counters that
+# explain where the per-tx work went
+HOST_REQUIRED = {
+    "unmarshal_s": _NUM,
+    "fiat_shamir_s": _NUM,
+    "sig_verify_s": _NUM,
+    "conservation_s": _NUM,
+    "input_match_s": _NUM,
+    "host_validate_frac": _NULLABLE_NUM,
+}
+
+HOST_OPTIONAL = {
+    # per-block p99 of the named host legs over the window (null when
+    # no block ran the leg)
+    "unmarshal_p99_s": _NULLABLE_NUM,
+    "fiat_shamir_p99_s": _NULLABLE_NUM,
+    # wall spent inside the block-level batch passes (outside the legs)
+    "sign_batch_s": _NUM,
+    "proof_batch_s": _NUM,
+    "conservation_batch_s": _NUM,
+    # rows those passes decided (hostbatch.* counter deltas)
+    "sign_batch_rows": int,
+    "proof_batch_rows": int,
+    "conservation_rows": int,
+    # parse-cache effectiveness over the window (null when cold/disabled)
+    "request_cache_hit_rate": _NULLABLE_NUM,
+    "parse_cache_hit_rate": _NULLABLE_NUM,
+    # resolved FTS_COMMIT_WORKERS pool size the window ran with
+    "workers": int,
+}
+
+
+def validate_host(host) -> List[str]:
+    """Schema problems of one `host` section (empty list = valid)."""
+    if not isinstance(host, dict):
+        return [f"host is {type(host).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, host, HOST_REQUIRED, required=True)
+    _check(problems, host, HOST_OPTIONAL, required=False)
+    for key in HOST_REQUIRED:
+        v = host.get(key)
+        if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
+            problems.append(f"host.{key} is negative")
+    for key in ("request_cache_hit_rate", "parse_cache_hit_rate",
+                "host_validate_frac"):
+        v = host.get(key)
+        if isinstance(v, _NUM) and not isinstance(v, bool) and not (
+            0 <= v <= 1
+        ):
+            problems.append(f"host.{key}={v} outside [0, 1]")
+    return problems
+
 
 # the state-plane scale section (`state` field, bench `state_scale`
 # phase): synthetic token count populated into a persistent vault,
@@ -399,6 +457,8 @@ def validate_result(result) -> List[str]:
         problems.extend(validate_slo(result["slo"]))
     if isinstance(result.get("device"), dict):
         problems.extend(validate_device(result["device"]))
+    if isinstance(result.get("host"), dict):
+        problems.extend(validate_host(result["host"]))
     return problems
 
 

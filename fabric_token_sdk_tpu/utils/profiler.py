@@ -143,6 +143,7 @@ _roles_lock = threading.Lock()
 # named at spawn; registration beats this map when both apply)
 _NAME_ROLES = (
     ("fts-block-commit", "commit-worker"),
+    ("fts-commit-host", "commit-worker"),
     ("fts-soak-client", "client"),
 )
 

@@ -156,6 +156,42 @@ def test_wf_verifier_is_transfer_shape_invariant(rng, pp):
     )
 
 
+def test_host_batch_path_compiles_zero_programs(rng, pp):
+    """The batch-first HOST validation plane (FTS_HOST_BATCH) is pure
+    host work — native ctypes multiexp, one batched sha256 dispatch,
+    column arithmetic, thread-pool fan-out. Committing a zk block whose
+    rows ALL route to the host passes (min_batch above the block size:
+    every plannable row is a device leftover consumed by
+    `_host_proof_batch`, signatures by the block sign batch) must
+    compile ZERO XLA programs. No warmup gate: this holds cold."""
+    from test_orderer import build_env, issue_to, manual_transfer
+    from fabric_token_sdk_tpu.drivers.zkatdlog import ZKATDLogDriver
+    from fabric_token_sdk_tpu.services.network import BlockPolicy
+
+    network, parties, issuer, alice, bob = build_env(
+        lambda: ZKATDLogDriver(pp),
+        BlockPolicy(max_block_txs=8, min_batch=99, use_batched=True),
+    )
+    alice_p = parties["alice-node"]
+    issue_to(parties, alice, [5] * 3, "hb-seed")
+    reqs = [
+        manual_transfer(alice_p, tid, 5, bob.recipient_identity(), f"hb-{i}")
+        for i, tid in enumerate(alice_p.vault.token_ids())
+    ]
+
+    hb_before = mx.REGISTRY.counter("hostbatch.proof.rows").value
+    before = _compiles()
+    events = network.submit_many([r.to_bytes() for r in reqs])
+    assert all(e.status.value == "Valid" for e in events)
+    # the block really rode the host batch pass...
+    assert mx.REGISTRY.counter("hostbatch.proof.rows").value - hb_before == 3
+    # ...which compiled nothing: the host path never touches XLA
+    assert _compiles() - before == 0, (
+        "the batch-first host validation path compiled XLA programs — "
+        "host batching must stay off the device plane entirely"
+    )
+
+
 @pytest.mark.skipif(
     os.environ.get("FTS_WARMUP") != "1",
     reason="needs the FTS_WARMUP=1 session precompile (conftest fixture)",

@@ -260,6 +260,53 @@ def test_device_ledger_names_pinned_both_ways():
     assert "`FTS_DEVOBS`" in doc, "FTS_DEVOBS missing from switches table"
 
 
+def test_host_batch_names_pinned_both_ways():
+    """The batch-first host-validation PR's names cannot drift in
+    either direction: the proved-row counters, the request/parse cache
+    counters, the per-pass block histograms, the multiexp path
+    counters, the host-batch flight kinds, and the four switches the
+    code reads must be emitted by the code AND documented."""
+    emitted, corpus = _emitted()
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    exact, _prefixes = _doc_names(doc)
+
+    counters = (
+        "hostbatch.sign.rows",
+        "hostbatch.proof.rows",
+        "hostbatch.conservation.rows",
+        "request.cache.hits",
+        "request.cache.misses",
+        "request.cache.evictions",
+        "parse.cache.hits",
+        "parse.cache.misses",
+        "hostmath.g1_multiexp_rows.native",
+        "hostmath.g1_multiexp_rows.python",
+    )
+    for name in counters:
+        assert ("counter", name) in emitted, f"{name} no longer emitted"
+        assert name in exact, f"{name} undocumented"
+
+    for name in (
+        "ledger.block.host_sign_batch.seconds",
+        "ledger.block.host_proof_batch.seconds",
+        "ledger.block.host_conservation.seconds",
+    ):
+        assert ("histogram", name) in emitted, f"{name} no longer emitted"
+        assert name in exact, f"{name} undocumented"
+
+    doc_flight = _doc_flight_kinds(doc)
+    for kind in ("sign.host_batch", "verify.host_batch",
+                 "request.cache.evict"):
+        assert ("flight", kind) in emitted, f"{kind} no longer emitted"
+        assert kind in doc_flight, f"{kind} missing from flight taxonomy"
+
+    for knob in ("FTS_HOST_BATCH", "FTS_COMMIT_WORKERS",
+                 "FTS_REQUEST_CACHE", "FTS_PARSE_CACHE"):
+        assert f'"{knob}"' in corpus, f"code no longer reads {knob}"
+        assert f"`{knob}`" in doc, f"{knob} missing from switches table"
+
+
 def _wire_ops():
     """Every RPC op name `LedgerServer._dispatch_op` handles (the live
     wire protocol, ops plane included)."""

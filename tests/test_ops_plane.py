@@ -281,6 +281,8 @@ def test_ops_rpcs_answer_live_during_slow_commits(tmp_path):
         "sign_verify_s", "host_validate_s", "host_unmarshal_s",
         "host_fiat_shamir_s", "host_sig_verify_s",
         "host_conservation_s", "host_input_match_s", "wal_s", "merge_s",
+        "host_sign_batch_s", "host_proof_batch_s",
+        "host_conservation_batch_s",
     }
 
 
