@@ -1103,6 +1103,215 @@ def _soak(hb, zk_pp=None) -> dict:
     return soak
 
 
+def _failover_soak(hb) -> dict:
+    """Kill-the-leader chaos soak (`FTS_BENCH_SOAK_FAILOVER=1`): a
+    journaled leader ships committed blocks to one journaled follower
+    while N `RemoteNetwork` clients — each holding BOTH endpoints —
+    drive exactly-once issue traffic. At the half-window mark the
+    leader is torn down abruptly; the follower's lease watchdog
+    promotes it (fencing epoch bump) and the clients ride their
+    failover machinery onto the new leader. The recorded section is
+    the replication CONTRACT as numbers: `acked_tx_loss` (acked tx ids
+    the promoted node does not hold Valid — must be 0),
+    `duplicate_commits` (tx ids committed in more than one block across
+    the switch — must be 0), `failover_p99_s` (p99 client-observed
+    submit wall across the post-kill half), `follower_lag_max` (max
+    shipped-height lag seen before the kill). Schema
+    `benchschema.FAILOVER_*`, gated by `ftstop compare --failover`.
+    Sized by FTS_BENCH_SOAK_S / _CLIENTS, budget-aware like the soak."""
+    import tempfile
+
+    from fabric_token_sdk_tpu.api.request import IssueRecord, TokenRequest
+    from fabric_token_sdk_tpu.api.validator import RequestValidator
+    from fabric_token_sdk_tpu.crypto import sign
+    from fabric_token_sdk_tpu.drivers import identity
+    from fabric_token_sdk_tpu.drivers.fabtoken import (
+        FabTokenDriver,
+        FabTokenPublicParams,
+    )
+    from fabric_token_sdk_tpu.services.network import Network, replication
+    from fabric_token_sdk_tpu.services.network.remote import (
+        LedgerServer,
+        RemoteNetwork,
+    )
+
+    mx = _metrics()
+    import random
+
+    clients = max(1, int(os.environ.get("FTS_BENCH_SOAK_CLIENTS", "4")))
+    duration = float(os.environ.get("FTS_BENCH_SOAK_S", "12"))
+    remaining = _remaining_budget_s()
+    if remaining is not None:
+        if remaining < 20:
+            print(
+                f"[fts-bench] failover soak: only {remaining:.0f}s of "
+                "watchdog budget left — skipping",
+                file=sys.stderr, flush=True,
+            )
+            return {}
+        duration = min(duration, remaining * 0.5)
+    hb.set_phase("failover_soak", clients=clients,
+                 duration_s=round(duration, 1))
+    root = tempfile.mkdtemp(prefix="fts-failover-")
+    pp = FabTokenPublicParams()
+
+    def make_net(name):
+        return Network(
+            RequestValidator(FabTokenDriver(pp)),
+            wal_path=os.path.join(root, f"{name}.wal"),
+        )
+
+    switches_before = mx.REGISTRY.counter("remote.failover.switches").value
+    stale_before = mx.REGISTRY.counter("repl.stale_rejected").value
+    # short lease so the auto-promotion fits the window; env always wins
+    lease_set = "FTS_REPL_LEASE_S" not in os.environ
+    if lease_set:
+        os.environ["FTS_REPL_LEASE_S"] = "1.0"
+    leader_net, follower_net = make_net("leader"), make_net("follower")
+    follower_srv = LedgerServer(network=follower_net).start()
+    leader_srv = LedgerServer(network=leader_net).start()
+    follower_state = replication.attach_follower(
+        follower_net, auto_promote=True
+    )
+    replication.attach_leader(leader_net, [follower_srv.address])
+    endpoints = [leader_srv.address, follower_srv.address]
+
+    stop = threading.Event()
+    killed_at = [None]  # monotonic stamp of the kill, set by the killer
+    lock = threading.Lock()
+    acked: set = set()
+    post_latencies: list = []
+    lag_max = [0]
+    errors: list = []
+
+    def lag_sampler():
+        while not stop.is_set() and killed_at[0] is None:
+            repl = getattr(leader_net, "repl", None)
+            if repl is not None:
+                lag = repl.health_section().get("lag") or 0
+                with lock:
+                    lag_max[0] = max(lag_max[0], int(lag))
+            stop.wait(0.05)
+
+    def client(idx):
+        rng = random.Random(0xFA11 + idx)
+        drv = FabTokenDriver(pp)
+        key = sign.keygen(rng)
+        ident = identity.pk_identity(key.public)
+        remote = RemoteNetwork(endpoints=endpoints, timeout=2.0,
+                               retries=10, backoff_s=0.1)
+        try:
+            k = 0
+            while not stop.is_set():
+                anchor = f"failover-{idx}-{k}"
+                k += 1
+                outcome = drv.issue(ident, "USD", [5], [ident],
+                                    anonymous=False)
+                req = TokenRequest(anchor=anchor)
+                req.issues.append(
+                    IssueRecord(action=outcome.action_bytes, issuer=ident,
+                                outputs_metadata=outcome.metadata,
+                                receivers=[ident])
+                )
+                req.issues[0].signature = key.sign(req.marshal_to_sign(),
+                                                   rng)
+                t0 = time.monotonic()
+                try:
+                    ev = remote.submit(req.to_bytes())
+                except Exception:
+                    continue  # unacked: allowed to be lost
+                dt = time.monotonic() - t0
+                if ev.status.value != "Valid":
+                    raise AssertionError(
+                        f"failover client {idx} rejected: {ev.message}"
+                    )
+                with lock:
+                    acked.add(anchor)
+                    if killed_at[0] is not None:
+                        post_latencies.append(dt)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+        finally:
+            remote.close()
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"fts-failover-client-{i}")
+        for i in range(clients)
+    ]
+    sampler = threading.Thread(target=lag_sampler, daemon=True)
+    t_begin = time.monotonic()
+    try:
+        sampler.start()
+        for t in threads:
+            t.start()
+        time.sleep(duration / 2)
+        # the kill: abrupt teardown of the leader node — live client
+        # connections are severed, the follower's heartbeats stop, and
+        # its lease watchdog must promote it without operator help
+        killed_at[0] = time.monotonic()
+        leader_srv.stop()
+        deadline = time.monotonic() + max(10.0, duration)
+        while (follower_state.role != "leader"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        time.sleep(duration / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        sampler.join(timeout=5)
+    finally:
+        stop.set()
+        if lease_set:
+            os.environ.pop("FTS_REPL_LEASE_S", None)
+        try:
+            follower_srv.stop()
+        except Exception:
+            pass
+    if errors:
+        raise errors[0]
+    if follower_state.role != "leader":
+        raise AssertionError("follower never promoted after the kill")
+    # the contract, measured on the promoted node's in-process ledger:
+    # every acked tx present and Valid, no tx id in two blocks
+    lost = sum(
+        1 for a in acked
+        if (ev := follower_net.status(a)) is None
+        or ev.status.value != "Valid"
+    )
+    seen: dict = {}
+    for block in follower_net._blocks:
+        for txid in block.txs:
+            seen[txid] = seen.get(txid, 0) + 1
+    duplicates = sum(n - 1 for n in seen.values() if n > 1)
+    post = sorted(post_latencies)
+    p99 = post[max(0, int(len(post) * 0.99) - 1)] if post else None
+    failover = {
+        "acked_tx_loss": int(lost),
+        "duplicate_commits": int(duplicates),
+        "failover_p99_s": round(p99, 4) if p99 is not None else None,
+        "follower_lag_max": int(lag_max[0]),
+        "acked_txs": len(acked),
+        "killed_at_s": round(killed_at[0] - t_begin, 2),
+        "promoted_epoch": int(follower_state.epoch),
+        "promotion": "auto",
+        "failover_switches": int(
+            mx.REGISTRY.counter("remote.failover.switches").value
+            - switches_before
+        ),
+        "stale_rejected": int(
+            mx.REGISTRY.counter("repl.stale_rejected").value - stale_before
+        ),
+    }
+    mx.gauge("bench.failover_acked_tx_loss").set(failover["acked_tx_loss"])
+    mx.gauge("bench.failover_duplicate_commits").set(
+        failover["duplicate_commits"]
+    )
+    if p99 is not None:
+        mx.gauge("bench.failover_p99_s").set(failover["failover_p99_s"])
+    return failover
+
+
 def _state_workload(vault, threads: int, selects: int, duration_s: float,
                     spend: bool = True) -> dict:
     """Concurrent select+spend pressure over one vault: N workers race
@@ -1590,6 +1799,24 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             print(
                 f"[fts-bench] soak phase failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    # kill-the-leader chaos-soak rider (FTS_BENCH_SOAK_FAILOVER=1 opts
+    # IN): leader + follower + lease-watchdog promotion under live
+    # exactly-once client traffic; the replication contract joins the
+    # result as the validated `failover` section
+    if os.environ.get("FTS_BENCH_SOAK_FAILOVER", "0") == "1":
+        try:
+            failover = _failover_soak(hb)
+            if failover:
+                result["failover"] = failover
+                print(json.dumps(result), flush=True)
+        except Exception as e:  # pragma: no cover
+            print(
+                f"[fts-bench] failover soak phase failed: "
+                f"{type(e).__name__}: {e}",
                 file=sys.stderr,
                 flush=True,
             )

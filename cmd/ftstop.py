@@ -11,6 +11,7 @@ Usage:
     python cmd/ftstop.py compare --history BENCH_history.jsonl --slo
     python cmd/ftstop.py compare --history BENCH_history.jsonl --device
     python cmd/ftstop.py compare --history BENCH_history.jsonl --host
+    python cmd/ftstop.py compare --history BENCH_history.jsonl --failover
 
 `top` polls a live node's ops RPCs (`ops.health` + `ops.metrics`, both
 side-effect-free and commit-lock-free server-side) and renders one line
@@ -150,6 +151,17 @@ def format_row(health: dict, snap: dict, prev_snap: Optional[dict],
                 f"{name}!{r.get('burn', 0):.1f}x"
                 for name, r in sorted(breaching.items())
             ) if breaching else "ok")
+        )
+    # replication column: the node's place in the replicated plane —
+    # `repl=leader@e3 lag=0` (worst follower lag) on a leader,
+    # `repl=follower@e3 lag=2` (blocks behind the shipped stream) on a
+    # follower. Absent on standalone nodes and nodes predating the
+    # replication plane (health carries no `repl` section).
+    repl = health.get("repl")
+    if isinstance(repl, dict):
+        parts.append(
+            f"repl={repl.get('role', '?')}@e{repl.get('epoch', '?')} "
+            f"lag={repl.get('lag', '-')}"
         )
     wal = health.get("wal")
     if wal:
@@ -618,6 +630,73 @@ def compare_host(args) -> int:
     )
 
 
+def failover_of(result: dict) -> Optional[dict]:
+    """The `failover` section of one schema-valid bench result, or None.
+    (Callers filter through `validate_result` first, which already
+    field-checks any dict-typed failover section.)"""
+    s = result.get("failover")
+    return s if isinstance(s, dict) else None
+
+
+# (failover field, direction): +1 = higher is better, -1 = lower better
+FAILOVER_METRICS = (
+    ("acked_tx_loss", -1),
+    ("duplicate_commits", -1),
+    ("failover_p99_s", -1),
+    ("follower_lag_max", -1),
+)
+
+
+def compare_failover(args) -> int:
+    """The replication observatory: gate the kill-the-leader chaos-soak
+    contract. Two verdicts layered: the LOSS metrics (`acked_tx_loss`,
+    `duplicate_commits`) are ABSOLUTE — any nonzero value in the latest
+    round is a regression regardless of the baseline, because the
+    relative engine's `(new - base) / base` arithmetic treats a 0 -> 1
+    jump on a zero baseline as 0% change and would wave the one
+    regression this gate exists to catch straight through. The latency
+    metrics (`failover_p99_s`, `follower_lag_max`) gate relatively
+    against the median of prior failover-carrying rounds, same contract
+    as `--soak`/`--host`."""
+    rc = _gate_sections(
+        args, "failover", failover_of, FAILOVER_METRICS,
+        lambda s: (
+            f"failover, latest round: acked={s.get('acked_txs', '-')} "
+            f"loss={s['acked_tx_loss']} dups={s['duplicate_commits']} "
+            f"p99={s.get('failover_p99_s')}s "
+            f"lag_max={s['follower_lag_max']:g} "
+            f"epoch={s.get('promoted_epoch', '-')} "
+            f"promotion={s.get('promotion', '-')} "
+            f"switches={s.get('failover_switches', 0)}"
+        ),
+    )
+    if rc == 2:
+        return rc
+    # the absolute layer: zero-tolerance on the correctness metrics
+    from fabric_token_sdk_tpu.utils import benchschema
+
+    sections = []
+    for row in benchschema.load_history(args.history):
+        result = benchschema.extract_result(row)
+        if not result or benchschema.validate_result(result):
+            continue
+        s = failover_of(result)
+        if s:
+            sections.append(s)
+    if args.last:
+        sections = sections[-args.last:]
+    hard = 0
+    for key in ("acked_tx_loss", "duplicate_commits"):
+        v = sections[-1].get(key) if sections else None
+        if _num(v) and v > 0:
+            hard += 1
+            print(f"REGRESSION   failover.{key:<17} {v:g}  "
+                  "(absolute: any nonzero value fails the gate)")
+    if hard:
+        return 1 if not args.no_fail else rc
+    return rc
+
+
 def compare_slo(args) -> int:
     """The SLO gate: unlike the regression observatories (which diff
     against prior rounds), this is an ABSOLUTE verdict on the latest
@@ -821,6 +900,13 @@ def main(argv=None) -> int:
                              "fraction of commit wall and unmarshal / "
                              "fiat_shamir p99 (growth) vs the median of "
                              "prior host-carrying rounds (history mode only)")
+    p_gate.add_argument("--failover", action="store_true",
+                        help="gate on the kill-the-leader chaos soak: "
+                             "acked-tx loss and duplicate commits "
+                             "(absolute — any nonzero fails), failover p99 "
+                             "and follower lag (growth) vs the median of "
+                             "prior failover-carrying rounds (history mode "
+                             "only)")
     p_cmp.add_argument("--no-fail", action="store_true",
                        help="exit 0 even when regressions are flagged")
     args = ap.parse_args(argv)
@@ -854,6 +940,10 @@ def main(argv=None) -> int:
         if not args.history:
             ap.error("compare --host needs --history")
         return compare_host(args)
+    if args.failover:
+        if not args.history:
+            ap.error("compare --failover needs --history")
+        return compare_failover(args)
     if not args.history and (not args.old or not args.new):
         ap.error("compare needs OLD and NEW files, or --history")
     return compare(args)

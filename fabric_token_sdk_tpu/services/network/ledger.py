@@ -167,6 +167,12 @@ class Network:
             WriteAheadLog(wal_path) if wal_path else None
         )
         self._snapshot_path = (str(wal_path) + ".snap") if wal_path else None
+        # replication plane (services/network/replication.py): None on a
+        # standalone node — attached by `replication.enable(...)`, which
+        # makes this node a leader (WAL shipper) or a follower (delta
+        # applier + promotion watchdog). The commit path only ever calls
+        # `self.repl.on_commit(...)`, which is bounded and degrade-only.
+        self.repl = None
 
     # ------------------------------------------------------------ queries
 
@@ -242,6 +248,11 @@ class Network:
             # bytes): lifetime hit/miss counters — a cold or thrashing
             # cache shows up here before it shows up as host-leg wall
             "caches": self._caches_section(),
+            # replication plane (services/network/replication.py): role,
+            # fencing epoch, and per-follower ship lag — None on a
+            # standalone (un-replicated) node, which is how `ftstop top`
+            # knows not to render a repl column for old nodes
+            "repl": self.repl.health_section() if self.repl else None,
         }
 
     @staticmethod
@@ -561,6 +572,14 @@ class Network:
                     "wal.append", block=len(self._blocks), bytes=len(record),
                     txs=[e.tx_id for e in events if not e.transient],
                 )
+                if self.repl is not None:
+                    # ship the journaled record to followers BEFORE the
+                    # submitters are resolved (below): an acknowledged tx
+                    # is replicated first. Degrade-only for the leader —
+                    # the wait is bounded, a slow/hung/dead follower is
+                    # dropped loudly (counted + breaker), never stalls
+                    # this commit.
+                    self.repl.on_commit(len(self._blocks), record)
             t0 = time.monotonic()
             with self._lock:
                 # atomic apply + finalize; transient-fault events resolve
@@ -775,6 +794,104 @@ class Network:
         self._wal.reset()
         mx.counter("wal.snapshots").inc()
 
+    # ---------------------------------------------------------- replication
+
+    def _apply_wal_record(self, d: dict) -> Block:
+        """Apply one decoded WAL record's durable delta to the in-memory
+        maps — the no-reverify replay path shared by crash recovery and
+        follower delta apply. The record IS the verdict: state delta and
+        per-tx statuses are applied as journaled, never re-validated.
+        Caller owns locking and height sequencing."""
+        for key in d["consumed"]:
+            self._state.pop(key, None)
+            self._spent.add(key)
+        self._state.update(d["outputs"])
+        txs = []
+        for tx_id, status, message in d["txs"]:
+            self._status[tx_id] = FinalityEvent(tx_id, TxStatus(status), message)
+            txs.append(tx_id)
+        block = Block(d["height"], txs, d["ts"])
+        self._blocks.append(block)
+        return block
+
+    def apply_delta(self, record: bytes) -> int:
+        """Follower-side replication apply: journal one shipped WAL
+        record to this node's OWN journal, then apply it through the
+        no-reverify replay path. Idempotent below the current height
+        (re-shipped records are skipped, not re-applied); a height GAP
+        raises `WALError` — the follower missed records and must be
+        re-bootstrapped, never guess-merged. Returns the new height."""
+        from ...crypto.serialization import loads
+
+        faults.fire("repl.apply")
+        d = loads(record)
+        height = d["height"]
+        with self._lock:
+            if height < len(self._blocks):
+                mx.counter("repl.apply.skipped").inc()
+                return len(self._blocks)
+            if height > len(self._blocks):
+                raise WALError(
+                    f"replication gap: shipped record at height {height} "
+                    f"but follower holds {len(self._blocks)} blocks "
+                    "(re-bootstrap required)"
+                )
+            if self._wal is not None:
+                # journal-first, same as the leader: a follower that
+                # crashes after this fsync recovers the block
+                self._wal.append(record)
+            self._apply_wal_record(d)
+            new_height = len(self._blocks)
+        mx.counter("repl.applied.records").inc()
+        mx.gauge("network.height").set(new_height)
+        # follower-side snapshot compaction, same cadence as the leader
+        # (degrade-only: a failure just means the journal keeps growing)
+        if (
+            self._wal is not None
+            and self.snapshot_every > 0
+            and new_height % self.snapshot_every == 0
+        ):
+            try:
+                self._compact()
+            except Exception:
+                mx.counter("wal.snapshot_failures").inc()
+                logger.exception(
+                    "repl: follower compaction failed; journal keeps growing"
+                )
+        return new_height
+
+    def install_snapshot(self, raw: bytes) -> int:
+        """Follower-side bootstrap: replace the live in-memory state with
+        the leader's snapshot wholesale, persist it as this node's own
+        `<wal>.snap`, and truncate the local journal — the shipped deltas
+        that follow build on exactly this base. Returns the new height."""
+        from ...crypto.serialization import loads
+
+        d = loads(raw)
+        with self._lock:
+            self._state = dict(d["state"])
+            self._spent = set(d["spent"])
+            self._blocks = [Block(*row) for row in d["blocks"]]
+            self._status = {
+                t: FinalityEvent(t, TxStatus(s), m)
+                for t, (s, m) in d["status"].items()
+            }
+            height = len(self._blocks)
+        if self._wal is not None:
+            try:
+                self._compact()
+            except Exception:
+                mx.counter("wal.snapshot_failures").inc()
+                logger.exception(
+                    "repl: bootstrap snapshot persist failed; follower "
+                    "holds the state in memory only until the next "
+                    "successful compaction"
+                )
+        mx.counter("repl.bootstraps").inc()
+        mx.gauge("network.height").set(height)
+        mx.flight("repl.bootstrap", height=height, bytes=len(raw))
+        return height
+
     def _notify(self, event: FinalityEvent, request: TokenRequest) -> None:
         """Per-listener crash isolation: a throwing finality listener is
         counted and logged, never allowed to abort the commit loop."""
@@ -843,7 +960,11 @@ class Network:
             net = cls(validator, policy=policy)
         wal = WriteAheadLog(wal_path)
         replayed = 0
-        for raw in wal.replay():
+        records = 0
+        # streaming replay (replay_iter): one record in memory at a time,
+        # so recovering a multi-GiB journal costs O(largest record) RSS
+        for _off, raw in wal.replay_iter():
+            records += 1
             d = loads(raw)
             height = d["height"]
             if height < len(net._blocks):
@@ -862,16 +983,9 @@ class Network:
                     f"wal {wal_path}: record at height {height} but ledger "
                     f"recovered only {len(net._blocks)} blocks (journal gap)"
                 )
-            for key in d["consumed"]:
-                net._state.pop(key, None)
-                net._spent.add(key)
-            net._state.update(d["outputs"])
-            txs = []
-            for tx_id, status, message in d["txs"]:
-                net._status[tx_id] = FinalityEvent(tx_id, TxStatus(status), message)
-                txs.append(tx_id)
-            net._blocks.append(Block(height, txs, d["ts"]))
+            net._apply_wal_record(d)
             replayed += 1
+        mx.counter("wal.replayed.records").inc(records)
         net._wal = wal
         net._snapshot_path = snap_path
         if snapshot_every is not None:

@@ -64,6 +64,7 @@ from ...utils import metrics as mx
 from ...utils.tracing import logger
 from .ledger import FinalityEvent, Network, TxStatus
 from .orderer import Backpressure, Submission
+from .replication import NotLeader, StaleEpoch
 
 DEFAULT_MAX_FRAME = 16 * 1024 * 1024  # 16 MiB
 
@@ -83,6 +84,23 @@ class RemoteError(RuntimeError):
     def __init__(self, message: str, error_class: Optional[str] = None):
         super().__init__(message)
         self.error_class = error_class
+
+
+def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:
+    """Parse `FTS_REMOTE_ENDPOINTS="host:port,host:port"` — the client's
+    view of a replicated cluster (order = initial preference)."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _sep, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"FTS_REMOTE_ENDPOINTS entry {part!r} is not host:port"
+            )
+        out.append((host, int(port)))
+    return out
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -193,9 +211,13 @@ class LedgerServer:
         self._stopping.set()
         self._server.shutdown()
         self._server.server_close()
-        # sever live client connections too: a stopped node must not keep
-        # answering from daemon handler threads (clients should observe
-        # the death and reconnect to the restarted node)
+        # sever live client connections BEFORE tearing replication down:
+        # once the shipper stops, an in-flight submit could still commit
+        # locally without ever reaching a follower — if its ack escaped
+        # to the client, that would be an acked tx a promoted follower
+        # does not hold (acked-loss). Severed first, the ack cannot
+        # flush; the client observes a dead node and resubmits through
+        # its exactly-once path on the new leader.
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
@@ -208,6 +230,12 @@ class LedgerServer:
                 conn.close()
             except OSError:
                 pass
+        # now the replication plane: the leader's links stop shipping, a
+        # follower's watchdog stops (it must not promote during an
+        # orderly stop)
+        repl = getattr(self.network, "repl", None)
+        if repl is not None:
+            repl.close()
 
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
@@ -237,6 +265,18 @@ class LedgerServer:
             mx.counter("remote.dispatch.backpressure").inc()
             return {"ok": False, "error": str(e),
                     "error_class": "Backpressure"}
+        except NotLeader as e:
+            # expected replication answer, not a server fault: the client
+            # fails over to the current leader (`_rediscover`)
+            mx.counter("remote.dispatch.not_leader").inc()
+            return {"ok": False, "error": str(e),
+                    "error_class": "NotLeader"}
+        except StaleEpoch as e:
+            # fencing verdict for a zombie ex-leader: typed so its
+            # shipper demotes itself instead of retrying (already counted
+            # under `repl.stale_rejected` at the fence)
+            return {"ok": False, "error": str(e),
+                    "error_class": "StaleEpoch"}
         except Exception as e:  # defensive: never kill the server loop —
             # but never mask the failure either: log the traceback
             # server-side and hand the client the typed exception
@@ -246,6 +286,28 @@ class LedgerServer:
                     "error_class": type(e).__name__}
 
     def _dispatch_op(self, op: str, msg: dict) -> dict:
+        repl = getattr(self.network, "repl", None)
+        if repl is not None and repl.role != "leader" and op in (
+            "submit", "submit_many"
+        ):
+            # a follower (or a fenced ex-leader) must never take writes:
+            # the client gets a typed answer and fails over to the leader
+            raise NotLeader(
+                f"node is a {repl.role} at epoch {repl.epoch}; "
+                "submit to the current leader"
+            )
+        # ---- replication plane (services/network/replication.py): the
+        # leader's shipper and the operator's promotion drive these; a
+        # node without an attached ReplicaState answers typed instead of
+        # guessing. NodeStopped still wins (checked in _dispatch), so a
+        # follower mid-bootstrap observes a stopping node cleanly.
+        if (op == "promote" or op == "repl.state" or op == "repl.bootstrap"
+                or op == "repl.ship" or op == "repl.heartbeat"):
+            if repl is None:
+                return {"ok": False,
+                        "error": "replication not enabled on this node",
+                        "error_class": "ReplicationDisabled"}
+            return repl.handle(op, msg)
         if op == "submit":
             ev = self.network.submit(bytes.fromhex(msg["request"]))
             # `transient` must cross the wire: a transient internal
@@ -335,11 +397,31 @@ class RemoteNetwork:
     so each party process drives its own vault via `apply_finality`.
     """
 
-    def __init__(self, address: Tuple[str, int],
+    def __init__(self, address: Optional[Tuple[str, int]] = None,
                  timeout: Optional[float] = None,
                  retries: Optional[int] = None,
-                 backoff_s: Optional[float] = None):
-        self.address = tuple(address)
+                 backoff_s: Optional[float] = None,
+                 endpoints: Optional[List[Tuple[str, int]]] = None):
+        # failover: `endpoints` (or FTS_REMOTE_ENDPOINTS="h:p,h:p") lists
+        # every node of a replicated cluster; `address` stays the
+        # backward-compatible single-node form and, when given, is the
+        # preferred first endpoint. `_rediscover()` re-probes the list
+        # when the current node dies or answers NotLeader/NodeStopped.
+        if endpoints is None:
+            env = os.environ.get("FTS_REMOTE_ENDPOINTS", "").strip()
+            endpoints = _parse_endpoints(env) if env else []
+        endpoints = [(str(h), int(p)) for h, p in endpoints]
+        if address is not None:
+            addr = (str(address[0]), int(address[1]))
+            if addr not in endpoints:
+                endpoints = [addr] + endpoints
+        if not endpoints:
+            raise ValueError(
+                "RemoteNetwork needs an address, endpoints=, or "
+                "FTS_REMOTE_ENDPOINTS"
+            )
+        self.endpoints: List[Tuple[str, int]] = endpoints
+        self.address = endpoints[0]
         self.timeout = (
             float(os.environ.get("FTS_REMOTE_TIMEOUT_S", "30"))
             if timeout is None else timeout
@@ -424,6 +506,66 @@ class RemoteNetwork:
         delay = self.backoff_s * (2 ** attempt) * (0.5 + self._rng.random())
         time.sleep(min(delay, 2.0))
 
+    # ------------------------------------------------------- failover
+
+    def _probe_endpoint(self, addr: Tuple[str, int]) -> Optional[Tuple[str, int]]:
+        """One fresh short-lived `ops.health` probe: returns (role,
+        epoch) — a node with no repl section is a standalone leader at
+        epoch -1 — or None for a dead/stopping node."""
+        try:
+            with socket.create_connection(
+                addr, timeout=min(self.timeout, 2.0)
+            ) as sock:
+                sock.settimeout(min(self.timeout, 2.0))
+                _send_msg(sock, {"op": "ops.health"})
+                resp = _recv_msg(sock)
+        except (OSError, FrameTooLarge, ValueError):
+            return None
+        if not resp or not resp.get("ok"):
+            return None
+        repl = (resp.get("health") or {}).get("repl")
+        if repl is None:
+            return ("leader", -1)
+        return (str(repl.get("role")), int(repl.get("epoch", 0)))
+
+    def _rediscover(self) -> bool:
+        """Find the current leader: probe every configured endpoint and
+        adopt the one claiming leadership, highest fencing epoch first
+        (two nodes can both claim it across a failover — the zombie's
+        epoch is strictly lower). Returns True when the pooled
+        connection was re-pointed at a NEW address."""
+        if len(self.endpoints) <= 1:
+            return False
+        best: Optional[Tuple[Tuple[str, int], int]] = None
+        for addr in self.endpoints:
+            info = self._probe_endpoint(addr)
+            if info is None:
+                continue
+            role, epoch = info
+            if role == "leader" and (best is None or epoch > best[1]):
+                best = (addr, epoch)
+        if best is None or best[0] == self.address:
+            return False
+        with self._lock:
+            old, self.address = self.address, best[0]
+            self._close_locked()
+        mx.counter("remote.failover.switches").inc()
+        mx.flight("failover", old=f"{old[0]}:{old[1]}",
+                  new=f"{best[0][0]}:{best[0][1]}", epoch=best[1])
+        logger.warning(
+            "remote: failed over %s:%d -> %s:%d (epoch %d)",
+            old[0], old[1], best[0][0], best[0][1], best[1],
+        )
+        return True
+
+    @staticmethod
+    def _failover_error(e: BaseException) -> bool:
+        """A typed answer that means 'this node cannot take writes' —
+        grounds to rediscover, exactly like a dead connection."""
+        return isinstance(e, RemoteError) and e.error_class in (
+            "NotLeader", "NodeStopped"
+        )
+
     def _call_idempotent(self, msg: dict) -> dict:
         """Retry transport failures with exponential backoff + jitter —
         ONLY safe for ops that do not mutate ledger state."""
@@ -432,14 +574,24 @@ class RemoteNetwork:
         for attempt in range(self.retries + 1):
             try:
                 return self._call(msg)
-            except (ConnectionError, OSError) as e:
+            except (ConnectionError, OSError, RemoteError) as e:
+                if isinstance(e, RemoteError) and not self._failover_error(e):
+                    raise  # a real server-side failure: not retryable
                 last = e
                 if attempt < self.retries:
                     mx.counter(f"remote.retry.{op}").inc()
                     mx.counter("remote.retry.attempts").inc()
                     mx.flight("retry", op=op, attempt=attempt)
                     self._backoff(attempt)
+                    # a dead/stopping/demoted node: look for the leader
+                    # before the next attempt (no-op for single-endpoint
+                    # clients)
+                    self._rediscover()
         mx.counter("remote.retry.exhausted").inc()
+        if isinstance(last, RemoteError):
+            # exhausted on a TYPED refusal (NodeStopped/NotLeader with no
+            # reachable leader): surface it typed, not as transport noise
+            raise last
         raise ConnectionError(
             f"remote {op} failed after {self.retries + 1} attempts: {last}"
         ) from last
@@ -494,7 +646,14 @@ class RemoteNetwork:
                           backpressure=True)
                 self._backoff(attempt)
                 continue
-            except (ConnectionError, OSError) as e:
+            except (ConnectionError, OSError, RemoteError) as e:
+                # a typed NotLeader/NodeStopped answer means the node
+                # cannot take this write — treated exactly like a dead
+                # connection: rediscover the leader, then ride the same
+                # status-probe exactly-once machinery (an acked tx is
+                # never lost or doubled across the switch)
+                if isinstance(e, RemoteError) and not self._failover_error(e):
+                    raise
                 last = e
                 if attempt >= self.retries:
                     break
@@ -504,6 +663,7 @@ class RemoteNetwork:
                 mx.counter("remote.retry.attempts").inc()
                 mx.flight("retry", op="submit", attempt=attempt, tx=tx_id)
                 self._backoff(attempt)
+                self._rediscover()
                 try:
                     with mx.span("remote.submit.recover", attempt=attempt):
                         known = self.status(tx_id)
@@ -517,6 +677,10 @@ class RemoteNetwork:
                 # the ledger has never recorded this tx: resubmitting is
                 # safe (and dedup'd server-side regardless)
         mx.counter("remote.retry.exhausted").inc()
+        if isinstance(last, RemoteError):
+            # exhausted on a TYPED refusal (follower with no reachable
+            # leader to fail over to): surface it typed
+            raise last
         raise ConnectionError(
             f"submit of {tx_id} failed after {self.retries + 1} attempts: {last}"
         ) from last
@@ -608,6 +772,13 @@ class RemoteNetwork:
         """The node's full `Registry.snapshot()` over the wire (counters,
         gauges, histograms WITH p50/p95/p99, span summary, phases)."""
         return self._call_idempotent({"op": "ops.metrics"})["snapshot"]
+
+    def promote(self) -> int:
+        """Explicit follower promotion (`promote` RPC) — the operator /
+        chaos-harness entry point. Idempotent server-side (a leader
+        answers with its current epoch), hence retry-safe. Returns the
+        node's fencing epoch after promotion."""
+        return int(self._call_idempotent({"op": "promote"})["epoch"])
 
     def ops_flight(self, n: Optional[int] = None) -> List[dict]:
         """Tail of the node's live flight-recorder ring (default

@@ -30,7 +30,7 @@ import os
 import struct
 import threading
 import zlib
-from typing import List
+from typing import Iterator, List, Tuple
 
 from ...utils import faults
 from ...utils import metrics as mx
@@ -144,34 +144,77 @@ class WriteAheadLog:
 
     # ------------------------------------------------------------ read
 
+    def replay_iter(self, from_offset: int = 0) -> Iterator[Tuple[int, bytes]]:
+        """Stream complete records from `from_offset` (a record boundary),
+        yielding `(next_offset, payload)` pairs oldest first.
+
+        The journal is read one frame at a time — never materialized
+        whole — so replaying a multi-GiB journal costs O(largest record)
+        memory, and a follower tail can resume from the last offset it
+        applied. The scan is bounded by the file size observed under the
+        lock at entry, so records appended concurrently (a live leader
+        shipping while committing) are simply not part of this pass; the
+        tailer re-enters with the last yielded offset to pick them up.
+
+        Torn-tail semantics match `replay()`: the first bad frame within
+        the scanned span — short header, short payload, CRC mismatch —
+        ends the stream, and the file is truncated back to the last good
+        boundary after re-verifying under the lock that no complete
+        record landed there in the meantime (so a concurrent append can
+        never be destroyed by a stale torn-tail verdict).
+        """
+        with self._lock:
+            self._fh.flush()
+            size = os.path.getsize(self.path)
+        good = from_offset
+        yielded = 0
+        with open(self.path, "rb") as fh:
+            fh.seek(good)
+            while good + _HDR.size <= size:
+                hdr = fh.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break  # short header: torn tail
+                n, crc = _HDR.unpack(hdr)
+                end = good + _HDR.size + n
+                if end > size:
+                    break  # partial payload: torn tail
+                payload = fh.read(n)
+                if len(payload) < n or zlib.crc32(payload) != crc:
+                    break  # corrupt frame: treat as torn tail
+                good = end
+                yielded += 1
+                yield good, payload
+        if good < size:
+            self._truncate_torn(good, yielded)
+
+    def _truncate_torn(self, good: int, records: int) -> None:
+        """Truncate a torn tail back to the record boundary `good`,
+        unless a complete record has landed there since the scan (a
+        concurrent append on a live journal must never be destroyed)."""
+        with self._lock:
+            self._fh.flush()
+            size = os.path.getsize(self.path)
+            if size <= good:
+                return
+            with open(self.path, "rb") as fh:
+                fh.seek(good)
+                hdr = fh.read(_HDR.size)
+                if len(hdr) == _HDR.size:
+                    n, crc = _HDR.unpack(hdr)
+                    payload = fh.read(n)
+                    if len(payload) == n and zlib.crc32(payload) == crc:
+                        return  # a whole record landed here: not torn
+            mx.counter("wal.torn_tails").inc()
+            mx.flight("wal.torn_tail", bytes=size - good, records=records)
+            logger.warning(
+                "wal: discarding %d-byte torn tail of %s after %d good "
+                "records", size - good, self.path, records,
+            )
+            self._reopen(good)
+
     def replay(self) -> List[bytes]:
         """Return every complete record, oldest first; truncate any torn
         tail back to the last good record boundary."""
-        with self._lock:
-            self._fh.flush()
-            with open(self.path, "rb") as fh:
-                data = fh.read()
-            out: List[bytes] = []
-            good = 0
-            while good + _HDR.size <= len(data):
-                n, crc = _HDR.unpack_from(data, good)
-                end = good + _HDR.size + n
-                if end > len(data):
-                    break  # partial payload: torn tail
-                payload = data[good + _HDR.size:end]
-                if zlib.crc32(payload) != crc:
-                    break  # corrupt frame: treat as torn tail
-                out.append(payload)
-                good = end
-            if good < len(data):
-                mx.counter("wal.torn_tails").inc()
-                mx.flight(
-                    "wal.torn_tail", bytes=len(data) - good, records=len(out)
-                )
-                logger.warning(
-                    "wal: discarding %d-byte torn tail of %s after %d good "
-                    "records", len(data) - good, self.path, len(out),
-                )
-                self._reopen(good)
+        out = [payload for _off, payload in self.replay_iter()]
         mx.counter("wal.replayed.records").inc(len(out))
         return out

@@ -70,6 +70,7 @@ OPTIONAL = {
     "slo": dict,  # error-budget section (validated per field)
     "device": dict,  # device-plane dispatch ledger (validated per field)
     "host": dict,  # batch-first host-validation section (per field)
+    "failover": dict,  # kill-the-leader chaos-soak section (per field)
     "ts": _NUM,  # history-line stamp added by bench.append_history
 }
 
@@ -179,6 +180,47 @@ def validate_host(host) -> List[str]:
             0 <= v <= 1
         ):
             problems.append(f"host.{key}={v} outside [0, 1]")
+    return problems
+
+
+# the kill-the-leader chaos-soak section (`failover` field, recorded by
+# `FTS_BENCH_SOAK_FAILOVER=1` and gated by `ftstop compare --failover`):
+# the replication contract as numbers — how many acknowledged txs the
+# promoted node LOST (must be 0), how many tx ids committed twice across
+# the switch (must be 0), the p99 client-observed submit stall across
+# the failover window (null when no client observed one), and the
+# maximum follower lag the window saw before the kill
+FAILOVER_REQUIRED = {
+    "acked_tx_loss": int,
+    "duplicate_commits": int,
+    "failover_p99_s": _NULLABLE_NUM,
+    "follower_lag_max": _NUM,
+}
+
+# type-checked when present: forensics of the window — acked total,
+# when the leader was killed (seconds into the window), the promoted
+# node's epoch, how the promotion happened, and client failover switches
+FAILOVER_OPTIONAL = {
+    "acked_txs": int,
+    "killed_at_s": _NUM,
+    "promoted_epoch": int,
+    "promotion": str,  # "auto" (lease watchdog) or "explicit" (RPC)
+    "failover_switches": int,
+    "stale_rejected": int,
+}
+
+
+def validate_failover(failover) -> List[str]:
+    """Schema problems of one `failover` section (empty list = valid)."""
+    if not isinstance(failover, dict):
+        return [f"failover is {type(failover).__name__}, expected object"]
+    problems: List[str] = []
+    _check(problems, failover, FAILOVER_REQUIRED, required=True)
+    _check(problems, failover, FAILOVER_OPTIONAL, required=False)
+    for key in ("acked_tx_loss", "duplicate_commits", "follower_lag_max"):
+        v = failover.get(key)
+        if isinstance(v, _NUM) and not isinstance(v, bool) and v < 0:
+            problems.append(f"failover.{key} is negative")
     return problems
 
 
@@ -459,6 +501,8 @@ def validate_result(result) -> List[str]:
         problems.extend(validate_device(result["device"]))
     if isinstance(result.get("host"), dict):
         problems.extend(validate_host(result["host"]))
+    if isinstance(result.get("failover"), dict):
+        problems.extend(validate_failover(result["failover"]))
     return problems
 
 

@@ -27,6 +27,18 @@ Registered sites (grep for `faults.fire` to confirm the live set):
     vault.recover        at the start of `PersistentTokenStore.recover`
     selector.lock        inside `ShardedLocker.try_lock` (kind `delay`
                          widens contention windows for chaos runs)
+    repl.ship            leader-side, on the follower link's thread
+                         before one WAL record is shipped (degrades that
+                         ONE link — the bounded ack wait keeps the
+                         commit path live; drops are counted loudly)
+    repl.apply           follower-side, at the start of
+                         `Network.apply_delta` (an error surfaces as a
+                         typed answer to the shipper, which reconnects
+                         and re-syncs from the journal)
+    repl.heartbeat       leader-side, on the link thread before a lease
+                         heartbeat (kind `drop`/`hang` starves the
+                         follower's lease — how the auto-promotion
+                         watchdog is chaos-tested)
 
 Arming:
 

@@ -294,3 +294,109 @@ def test_history_roundtrip_with_torn_tail(tmp_path):
         assert "ts" in row
         assert benchschema.validate_result(row) == []
     assert benchschema.is_degraded(rows[1]) and not benchschema.is_degraded(rows[0])
+
+def _failover(loss=0, dups=0, p99=0.4, lag=3):
+    return {
+        "acked_tx_loss": loss,
+        "duplicate_commits": dups,
+        "failover_p99_s": p99,
+        "follower_lag_max": lag,
+        "acked_txs": 40,
+        "killed_at_s": 6.0,
+        "promoted_epoch": 1,
+        "promotion": "auto",
+        "failover_switches": 1,
+        "stale_rejected": 2,
+    }
+
+
+def test_failover_section_schema():
+    """The kill-the-leader soak section is field-checked like state/
+    scaling: valid sections pass, malformed ones are named, and the
+    contract fields reject negatives and bool-as-int."""
+    r = _full()
+    r["failover"] = _failover()
+    assert benchschema.validate_result(r) == []
+    assert benchschema.validate_failover(r["failover"]) == []
+    assert benchschema.validate_failover("nope")
+    assert benchschema.validate_failover({})  # required fields missing
+    # p99 is nullable (no post-kill acks recorded -> null, still valid)
+    ok = _failover()
+    ok["failover_p99_s"] = None
+    assert benchschema.validate_failover(ok) == []
+    broken = _failover()
+    broken["acked_tx_loss"] = -1
+    assert any("negative" in p
+               for p in benchschema.validate_failover(broken))
+    broken = _failover()
+    broken["duplicate_commits"] = True  # bool IS an int subclass
+    assert benchschema.validate_failover(broken)
+    broken = _failover()
+    broken["follower_lag_max"] = "high"
+    assert benchschema.validate_failover(broken)
+    # a result with a broken section fails result validation too
+    r["failover"] = broken
+    assert benchschema.validate_result(r)
+
+
+def _history_with_failovers(tmp_path, sections):
+    path = str(tmp_path / "BENCH_history.jsonl")
+    for s in sections:
+        r = _full()
+        if s is not None:
+            r["failover"] = s
+        bench.append_history(r, path=path)
+    return path
+
+
+def test_ftstop_failover_gate(tmp_path, capsys):
+    """`ftstop compare --failover` layers an ABSOLUTE zero-tolerance
+    check over the median gate: any nonzero acked_tx_loss or
+    duplicate_commits in the latest round fails, even when every prior
+    round was also zero (the rel-to-zero-baseline blind spot)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "cmd"))
+    try:
+        import ftstop
+    finally:
+        sys.path.pop(0)
+
+    # clean soaks -> ok; failover-less rounds are skipped
+    path = _history_with_failovers(
+        tmp_path, [_failover(), None, _failover(p99=0.42)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--failover"]) == 0
+    out = capsys.readouterr().out
+    assert "failover" in out and "acked_tx_loss" in out and "OK" in out
+
+    # zero-loss baseline, latest loses one acked tx: the relative gate
+    # sees 0 -> 1 as rel 0.0, the absolute layer still fails it
+    os.makedirs(tmp_path / "z", exist_ok=True)
+    path = _history_with_failovers(
+        tmp_path / "z", [_failover(), _failover(loss=1)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--failover"]) == 1
+    assert "absolute" in capsys.readouterr().out
+    assert ftstop.main(
+        ["compare", "--history", path, "--failover", "--no-fail"]
+    ) == 0
+
+    # duplicate commits are equally disqualifying
+    os.makedirs(tmp_path / "d", exist_ok=True)
+    path = _history_with_failovers(
+        tmp_path / "d", [_failover(), _failover(dups=2)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--failover"]) == 1
+
+    # failover p99 growth beyond the threshold trips the median gate
+    os.makedirs(tmp_path / "p", exist_ok=True)
+    path = _history_with_failovers(
+        tmp_path / "p", [_failover(), _failover(p99=5.0)]
+    )
+    assert ftstop.main(["compare", "--history", path, "--failover"]) == 1
+
+    # fewer than two failover-carrying rounds -> rc 2
+    os.makedirs(tmp_path / "s", exist_ok=True)
+    path = _history_with_failovers(tmp_path / "s", [None, _failover()])
+    assert ftstop.main(["compare", "--history", path, "--failover"]) == 2

@@ -307,6 +307,57 @@ def test_host_batch_names_pinned_both_ways():
         assert f"`{knob}`" in doc, f"{knob} missing from switches table"
 
 
+def test_replication_names_pinned_both_ways():
+    """The replicated-ledger-plane PR's names cannot drift in either
+    direction: the shipping/apply/bootstrap counters, the fencing and
+    role-change counters, the client-failover counters, the ship-wait
+    histogram, the replication flight kinds, and the switches the code
+    reads must be emitted by the code AND documented."""
+    emitted, corpus = _emitted()
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    exact, _prefixes = _doc_names(doc)
+
+    counters = (
+        "repl.shipped.records",
+        "repl.ship.dropped",
+        "repl.ship.ack_timeouts",
+        "repl.applied.records",
+        "repl.apply.skipped",
+        "repl.bootstraps",
+        "repl.bootstraps.sent",
+        "repl.heartbeats",
+        "repl.promotions",
+        "repl.demotions",
+        "repl.stale_rejected",
+        "repl.link.errors",
+        "repl.link.node_stopped",
+        "remote.dispatch.not_leader",
+        "remote.failover.switches",
+    )
+    for name in counters:
+        assert ("counter", name) in emitted, f"{name} no longer emitted"
+        assert name in exact, f"{name} undocumented"
+
+    name = "repl.ship.wait.seconds"
+    assert ("histogram", name) in emitted, f"{name} no longer emitted"
+    assert name in exact, f"{name} undocumented"
+
+    doc_flight = _doc_flight_kinds(doc)
+    for kind in ("repl.bootstrap", "repl.promote", "repl.demoted",
+                 "repl.fenced", "repl.link.stopped", "repl.ship.drop",
+                 "failover"):
+        assert ("flight", kind) in emitted, f"{kind} no longer emitted"
+        assert kind in doc_flight, f"{kind} missing from flight taxonomy"
+
+    for knob in ("FTS_REPL", "FTS_REPL_SHIP_TIMEOUT_S",
+                 "FTS_REPL_QUEUE_MAX", "FTS_REPL_HEARTBEAT_S",
+                 "FTS_REPL_LEASE_S", "FTS_REPL_AUTO_PROMOTE",
+                 "FTS_REMOTE_ENDPOINTS", "FTS_BENCH_SOAK_FAILOVER"):
+        assert f'"{knob}"' in corpus, f"code no longer reads {knob}"
+        assert f"`{knob}`" in doc, f"{knob} missing from switches table"
+
+
 def _wire_ops():
     """Every RPC op name `LedgerServer._dispatch_op` handles (the live
     wire protocol, ops plane included)."""

@@ -1,0 +1,709 @@
+"""Replicated ledger plane: WAL shipping, fencing epochs, failover.
+
+What is pinned here (services/network/replication.py + the follower
+apply path in ledger.py + the client failover path in remote.py):
+
+* `WriteAheadLog.replay_iter(from_offset)` — offset-resumable streaming
+  replay with torn-tail truncation (the follower-tailing primitive).
+* Leader→follower shipping: journal catch-up, snapshot bootstrap,
+  streaming deltas through the no-reverify replay path, lag via
+  `ops.health`.
+* Fencing epochs: stale frames answered with typed `StaleEpoch` (the
+  zombie's appends are REFUSED, never merged); a zombie leader demotes
+  itself on contact with a higher epoch.
+* Promotion: explicit `promote` RPC and the lease watchdog
+  (auto-promote), both epoch-bump-first and crash-persistent.
+* Degrade-only: `FTS_REPL=0` / zero followers leave the commit path
+  byte-identical; a hung or dead follower never stalls a commit.
+* Client failover: endpoint lists, leader rediscovery by highest
+  epoch, exactly-once across the switch.
+* The kill-the-leader chaos soak (slow): SIGKILL a leader subprocess
+  mid-workload, promote the follower, assert zero acked-tx loss, zero
+  duplicate commits, bounded failover, and live fencing.
+"""
+
+import os
+import random
+import select
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fabric_token_sdk_tpu.api.request import IssueRecord, TokenRequest
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.crypto import sign
+from fabric_token_sdk_tpu.drivers import identity
+from fabric_token_sdk_tpu.drivers.fabtoken import (
+    FabTokenDriver,
+    FabTokenPublicParams,
+)
+from fabric_token_sdk_tpu.services.network import TxStatus, replication
+from fabric_token_sdk_tpu.services.network.ledger import Network
+from fabric_token_sdk_tpu.services.network.remote import (
+    LedgerServer,
+    RemoteError,
+    RemoteNetwork,
+    _parse_endpoints,
+    _recv_msg,
+    _send_msg,
+)
+from fabric_token_sdk_tpu.services.network.wal import WriteAheadLog
+from fabric_token_sdk_tpu.utils import faults
+from fabric_token_sdk_tpu.utils import metrics as mx
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return mx.REGISTRY.counter(name).value
+
+
+def _wait(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _issue_bytes(drv, key, ident, rng, anchor, value=5):
+    outcome = drv.issue(ident, "USD", [value], [ident], anonymous=False)
+    req = TokenRequest(anchor=anchor)
+    req.issues.append(
+        IssueRecord(action=outcome.action_bytes, issuer=ident,
+                    outputs_metadata=outcome.metadata, receivers=[ident])
+    )
+    req.issues[0].signature = key.sign(req.marshal_to_sign(), rng)
+    return req.to_bytes()
+
+
+def _client_kit(seed=0xF75):
+    rng = random.Random(seed)
+    pp = FabTokenPublicParams()
+    drv = FabTokenDriver(pp)
+    key = sign.keygen(rng)
+    ident = identity.pk_identity(key.public)
+    return pp, drv, key, ident, rng
+
+
+def _fab_net(wal_path, pp=None, snapshot_every=0):
+    pp = pp or FabTokenPublicParams()
+    return Network(
+        RequestValidator(FabTokenDriver(pp)), wal_path=str(wal_path),
+        snapshot_every=snapshot_every,
+    )
+
+
+def _raw_rpc(address, msg, timeout=5.0):
+    """One framed request/response over a fresh socket — the zombie's
+    wire view, below every client nicety."""
+    with socket.create_connection(address, timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_msg(sock, msg)
+        return _recv_msg(sock)
+
+
+# ===================================================================
+# replay_iter: the follower-tailing / recovery-streaming primitive
+# ===================================================================
+
+
+def test_replay_iter_stream_offsets_and_resume(tmp_path):
+    wal = WriteAheadLog(tmp_path / "t.wal")
+    payloads = [b"alpha", b"", b"\x00" * 512, b"tail"]
+    for p in payloads:
+        wal.append(p)
+    got = list(wal.replay_iter())
+    assert [p for _off, p in got] == payloads
+    # offsets strictly increase and the last one is the journal size
+    offsets = [off for off, _p in got]
+    assert offsets == sorted(set(offsets))
+    assert offsets[-1] == os.path.getsize(wal.path)
+    # resuming from any yielded offset streams exactly the suffix
+    for i, (off, _p) in enumerate(got):
+        assert [p for _o, p in wal.replay_iter(off)] == payloads[i + 1:]
+    # replay() is the materialized equivalent
+    assert wal.replay() == payloads
+    wal.close()
+
+
+def test_replay_iter_truncates_torn_tail(tmp_path):
+    path = tmp_path / "t.wal"
+    wal = WriteAheadLog(path)
+    wal.append(b"one")
+    wal.append(b"two")
+    good_size = os.path.getsize(path)
+    before = _counter("wal.torn_tails")
+    with open(path, "ab") as fh:
+        fh.write(struct.pack(">II", 4096, 0xDEAD) + b"fragment")
+    assert [p for _o, p in wal.replay_iter()] == [b"one", b"two"]
+    assert _counter("wal.torn_tails") - before == 1
+    # the torn bytes are GONE from disk, not just skipped
+    assert os.path.getsize(path) == good_size
+    wal.append(b"three")
+    assert wal.replay() == [b"one", b"two", b"three"]
+    wal.close()
+
+
+# ===================================================================
+# degrade-only: disabled / followerless replication is a no-op
+# ===================================================================
+
+
+def test_attach_is_degrade_only(tmp_path, monkeypatch):
+    pp, drv, key, ident, rng = _client_kit()
+    # FTS_REPL=0: both attach functions answer None, repl stays unset
+    monkeypatch.setenv("FTS_REPL", "0")
+    net = _fab_net(tmp_path / "off.wal", pp)
+    assert replication.attach_leader(net, [("127.0.0.1", 1)]) is None
+    assert replication.attach_follower(net) is None
+    assert net.repl is None
+    monkeypatch.delenv("FTS_REPL")
+    # zero followers: same no-op by construction
+    assert replication.attach_leader(net, []) is None
+    assert net.repl is None
+    # the commit path is byte-identical to a standalone node: the WAL
+    # record of the same tx matches a never-attached twin exactly
+    req = _issue_bytes(drv, key, ident, rng, "solo-1")
+    ev = net.submit(req)
+    assert ev.status == TxStatus.VALID
+    twin = _fab_net(tmp_path / "twin.wal", pp)
+    ev = twin.submit(req)
+    assert ev.status == TxStatus.VALID
+    rec_a = WriteAheadLog(tmp_path / "off.wal").replay()
+    rec_b = WriteAheadLog(tmp_path / "twin.wal").replay()
+    assert len(rec_a) == len(rec_b) == 1
+
+    def _stable(raw):
+        import json
+        d = json.loads(raw)
+        d.pop("ts", None)
+        return d
+
+    assert _stable(rec_a[0]) == _stable(rec_b[0])
+    # a leader NEEDS a journal: shipping rides the WAL
+    plain = Network(RequestValidator(FabTokenDriver(pp)))
+    with pytest.raises(replication.ReplicationError):
+        replication.attach_leader(plain, [("127.0.0.1", 1)])
+
+
+# ===================================================================
+# shipping: catch-up, streaming, lag, promotion
+# ===================================================================
+
+
+def test_ship_catchup_health_and_promotion(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    # journal history BEFORE the follower exists: catch-up must stream it
+    for i in range(2):
+        ev = leader_net.submit(_issue_bytes(drv, key, ident, rng, f"pre-{i}"))
+        assert ev.status == TxStatus.VALID
+    follower_srv = LedgerServer(network=follower_net).start()
+    leader_srv = LedgerServer(network=leader_net).start()
+    applied_before = _counter("repl.applied.records")
+    try:
+        replication.attach_follower(follower_net)
+        state = replication.attach_leader(
+            leader_net, [follower_srv.address], heartbeat_s=0.1
+        )
+        assert state is not None and leader_net.repl is state
+        _wait(lambda: follower_net.height() == leader_net.height(),
+              what="follower catch-up")
+        # live commit flows as a delta through the no-reverify path
+        ev = leader_net.submit(_issue_bytes(drv, key, ident, rng, "live-0"))
+        assert ev.status == TxStatus.VALID
+        _wait(lambda: follower_net.height() == leader_net.height(),
+              what="live delta")
+        assert _counter("repl.applied.records") - applied_before == 3
+        # the follower holds the leader's verdicts without re-endorsing
+        assert follower_net.status("pre-0").status == TxStatus.VALID
+        assert follower_net.status("live-0").status == TxStatus.VALID
+        # lag and role ride ops.health on both sides
+        lh = leader_srv.network.health()["repl"]
+        assert lh["role"] == "leader"
+        assert lh["followers"][0]["state"] == "streaming"
+        assert lh["lag"] == 0
+        fh = follower_net.health()["repl"]
+        assert fh["role"] == "follower" and fh["lag"] == 0
+        # a standalone node publishes NO repl section (ftstop old-node
+        # contract), and ftstop renders the column from the section
+        standalone = _fab_net(tmp_path / "alone.wal", pp)
+        assert standalone.health()["repl"] is None
+        sys.path.insert(0, os.path.join(REPO_ROOT, "cmd"))
+        try:
+            import ftstop
+        finally:
+            sys.path.pop(0)
+        row = ftstop.format_row(leader_srv.network.health(),
+                                {"counters": {}, "gauges": {},
+                                 "histograms": {}}, None, None)
+        assert "repl=leader@e0 lag=0" in row
+        # explicit promotion over the wire: epoch bumps and persists
+        client = RemoteNetwork(follower_srv.address, timeout=5,
+                               retries=2, backoff_s=0.01)
+        promotions_before = _counter("repl.promotions")
+        assert client.promote() == 1
+        assert client.promote() == 1  # idempotent on a leader
+        assert _counter("repl.promotions") - promotions_before == 1
+        assert replication._load_epoch(
+            str(tmp_path / "follower.wal.epoch")) == 1
+        # the promoted node now accepts submits directly
+        ev = client.submit(_issue_bytes(drv, key, ident, rng, "post-promo"))
+        assert ev.status == TxStatus.VALID
+        client.close()
+    finally:
+        leader_srv.stop()
+        follower_srv.stop()
+
+
+def test_snapshot_bootstrap_for_compacted_leader(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    # snapshot_every=1: every commit compacts, so the journal never
+    # covers history — a fresh follower MUST bootstrap via snapshot
+    leader_net = _fab_net(tmp_path / "leader.wal", pp, snapshot_every=1)
+    for i in range(3):
+        ev = leader_net.submit(_issue_bytes(drv, key, ident, rng, f"c-{i}"))
+        assert ev.status == TxStatus.VALID
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    boots_before = _counter("repl.bootstraps")
+    sent_before = _counter("repl.bootstraps.sent")
+    try:
+        replication.attach_follower(follower_net)
+        replication.attach_leader(leader_net, [follower_srv.address])
+        _wait(lambda: follower_net.height() == leader_net.height(),
+              what="snapshot bootstrap")
+        assert _counter("repl.bootstraps") - boots_before == 1
+        assert _counter("repl.bootstraps.sent") - sent_before == 1
+        assert follower_net.status("c-2").status == TxStatus.VALID
+    finally:
+        follower_srv.stop()
+        leader_net.repl.close()
+
+
+# ===================================================================
+# fencing: stale appends refused, zombies demoted — never merged
+# ===================================================================
+
+
+def test_fencing_rejects_stale_frames_and_demotes_zombies(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    node_net = _fab_net(tmp_path / "node.wal", pp)
+    node_srv = LedgerServer(network=node_net).start()
+    try:
+        state = replication.attach_follower(node_net)
+        state.promote(reason="test")  # epoch 0 -> 1
+        stale_before = _counter("repl.stale_rejected")
+        # the zombie's raw append at its old epoch: typed refusal
+        resp = _raw_rpc(node_srv.address, {
+            "op": "repl.ship", "epoch": 0, "record": b"junk".hex(),
+        })
+        assert resp["ok"] is False
+        assert resp["error_class"] == "StaleEpoch"
+        assert _counter("repl.stale_rejected") - stale_before == 1
+        height_before = node_net.height()
+        # a full zombie LEADER (epoch 0, divergent journal) reattaching:
+        # the repl.state handshake teaches it the higher epoch and it
+        # demotes itself — nothing of its journal is ever merged
+        zombie_net = _fab_net(tmp_path / "zombie.wal", pp)
+        ev = zombie_net.submit(_issue_bytes(drv, key, ident, rng, "z-0"))
+        assert ev.status == TxStatus.VALID
+        demotions_before = _counter("repl.demotions")
+        zombie_state = replication.attach_leader(
+            zombie_net, [node_srv.address]
+        )
+        _wait(lambda: zombie_state.role == "follower",
+              what="zombie self-demotion")
+        assert _counter("repl.demotions") - demotions_before == 1
+        assert zombie_state.epoch >= 1  # adopted the fencing epoch
+        time.sleep(0.2)  # any in-flight zombie frames land (and bounce)
+        assert node_net.height() == height_before
+        assert node_net.status("z-0") is None
+        zombie_state.close()
+    finally:
+        node_srv.stop()
+
+
+def test_auto_promote_lease_watchdog(tmp_path, monkeypatch):
+    monkeypatch.setenv("FTS_REPL_LEASE_S", "0.3")
+    net = _fab_net(tmp_path / "f.wal")
+    promotions_before = _counter("repl.promotions")
+    state = replication.attach_follower(net, auto_promote=True)
+    try:
+        _wait(lambda: state.role == "leader", timeout=5.0,
+              what="lease-expiry auto-promotion")
+        assert _counter("repl.promotions") - promotions_before == 1
+        assert state.epoch == 1
+        # the epoch survived the promotion durably: a restart from the
+        # same paths comes back fenced-high
+        reborn = replication.attach_follower(net)
+        assert reborn.epoch == 1
+        reborn.close()
+    finally:
+        state.close()
+
+
+# ===================================================================
+# degrade-only under misbehaving followers
+# ===================================================================
+
+
+def test_hung_follower_never_stalls_commit(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    try:
+        replication.attach_follower(follower_net)
+        replication.attach_leader(
+            leader_net, [follower_srv.address], ship_timeout_s=0.3
+        )
+        _wait(lambda: leader_net.repl.shipper.link_states()[0]["state"]
+              == "streaming", what="link streaming")
+        timeouts_before = _counter("repl.ship.ack_timeouts")
+        # hang the NEXT ship on the link thread; the bounded ack wait
+        # must release the commit path long before the hang ends
+        faults.arm("repl.ship", "hang", count=1, delay_s=5.0)
+        t0 = time.monotonic()
+        ev = leader_net.submit(_issue_bytes(drv, key, ident, rng, "hung-0"))
+        wall = time.monotonic() - t0
+        assert ev.status == TxStatus.VALID
+        assert wall < 3.0, f"commit stalled {wall:.1f}s behind a hung link"
+        assert _counter("repl.ship.ack_timeouts") - timeouts_before >= 1
+        faults.clear()  # release the hung link thread
+        # the link recovers and the follower still converges
+        _wait(lambda: follower_net.height() == leader_net.height(),
+              what="post-hang convergence")
+    finally:
+        faults.clear()
+        follower_srv.stop()
+        leader_net.repl.close()
+
+
+def test_dead_follower_never_stalls_commit(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    # a port with no listener: the link can never reach streaming
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_addr = s.getsockname()
+    state = replication.attach_leader(
+        leader_net, [dead_addr], ship_timeout_s=0.3, queue_max=2
+    )
+    try:
+        dropped_before = _counter("repl.ship.dropped")
+        t0 = time.monotonic()
+        for i in range(4):
+            ev = leader_net.submit(
+                _issue_bytes(drv, key, ident, rng, f"dead-{i}")
+            )
+            assert ev.status == TxStatus.VALID
+        wall = time.monotonic() - t0
+        assert wall < 5.0, f"commits stalled {wall:.1f}s behind a dead link"
+        # the bounded queue overflowed LOUDLY instead of growing
+        assert _counter("repl.ship.dropped") - dropped_before >= 2
+        assert state.shipper.link_states()[0]["state"] != "streaming"
+    finally:
+        state.close()
+
+
+def test_node_stopped_follower_ends_link_cleanly(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    replication.attach_follower(follower_net)
+    state = replication.attach_leader(
+        leader_net, [follower_srv.address], heartbeat_s=0.05
+    )
+    try:
+        _wait(lambda: state.shipper.link_states()[0]["state"] == "streaming",
+              what="link streaming")
+        stopped_before = _counter("repl.link.node_stopped")
+        follower_srv.stop()
+        _wait(lambda: state.shipper.link_states()[0]["state"] == "stopped",
+              what="clean link stop")
+        assert _counter("repl.link.node_stopped") - stopped_before == 1
+        # an orderly stop is a demotion signal, not a retry storm: the
+        # link thread has exited for good
+        errors_before = _counter("repl.link.errors")
+        time.sleep(0.3)
+        assert _counter("repl.link.errors") == errors_before
+    finally:
+        state.close()
+
+
+# ===================================================================
+# typed answers + client failover
+# ===================================================================
+
+
+def test_follower_submit_rejected_typed_not_leader(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    follower_net = _fab_net(tmp_path / "f.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    try:
+        replication.attach_follower(follower_net)
+        nl_before = _counter("remote.dispatch.not_leader")
+        req = _issue_bytes(drv, key, ident, rng, "nope")
+        # the wire answer is TYPED, so clients can distinguish "ask the
+        # leader" from a real failure
+        resp = _raw_rpc(follower_srv.address,
+                        {"op": "submit", "request": req.hex()})
+        assert resp["ok"] is False
+        assert resp["error_class"] == "NotLeader"
+        assert _counter("remote.dispatch.not_leader") == nl_before + 1
+        # a single-endpoint client (no failover candidates) surfaces the
+        # TYPED refusal after exhausting retries instead of hanging or
+        # degrading it to transport noise
+        client = RemoteNetwork(follower_srv.address, timeout=5,
+                               retries=1, backoff_s=0.01)
+        with pytest.raises(RemoteError) as exc:
+            client.submit(req)
+        assert exc.value.error_class == "NotLeader"
+        client.close()
+        # and the follower recorded NO verdict for it
+        assert follower_net.status("nope") is None
+    finally:
+        follower_srv.stop()
+
+
+def test_repl_ops_on_standalone_answer_typed(tmp_path):
+    net = _fab_net(tmp_path / "s.wal")
+    srv = LedgerServer(network=net).start()
+    try:
+        resp = _raw_rpc(srv.address, {"op": "repl.ship", "epoch": 0,
+                                      "record": b"x".hex()})
+        assert resp["ok"] is False
+        assert resp["error_class"] == "ReplicationDisabled"
+        resp = _raw_rpc(srv.address, {"op": "promote"})
+        assert resp["ok"] is False
+        assert resp["error_class"] == "ReplicationDisabled"
+    finally:
+        srv.stop()
+
+
+def test_parse_endpoints_and_env(tmp_path, monkeypatch):
+    assert _parse_endpoints("a:1,b:2 , c:3") == [
+        ("a", 1), ("b", 2), ("c", 3)
+    ]
+    with pytest.raises(ValueError):
+        _parse_endpoints("no-port")
+    net = _fab_net(tmp_path / "s.wal")
+    srv = LedgerServer(network=net).start()
+    try:
+        host, port = srv.address
+        monkeypatch.setenv(
+            "FTS_REMOTE_ENDPOINTS", f"{host}:{port},{host}:{port + 1}"
+        )
+        client = RemoteNetwork(timeout=5, retries=1, backoff_s=0.01)
+        assert client.endpoints == [(host, port), (host, port + 1)]
+        assert client.address == (host, port)
+        assert client.height() == 0
+        client.close()
+        with pytest.raises(ValueError):
+            monkeypatch.setenv("FTS_REMOTE_ENDPOINTS", "")
+            RemoteNetwork()
+    finally:
+        srv.stop()
+
+
+def test_client_failover_rides_exactly_once(tmp_path):
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    leader_srv = LedgerServer(network=leader_net).start()
+    replication.attach_follower(follower_net)
+    replication.attach_leader(leader_net, [follower_srv.address])
+    client = RemoteNetwork(endpoints=[leader_srv.address,
+                                      follower_srv.address],
+                           timeout=5, retries=8, backoff_s=0.05)
+    try:
+        ev = client.submit(_issue_bytes(drv, key, ident, rng, "pre-kill"))
+        assert ev.status == TxStatus.VALID
+        _wait(lambda: follower_net.height() == leader_net.height(),
+              what="replication of the acked tx")
+        switches_before = _counter("remote.failover.switches")
+        leader_srv.stop()
+        follower_net.repl.promote(reason="test failover")
+        # the SAME client object survives the switch: the next submit
+        # rediscovers the promoted leader and commits exactly once
+        ev = client.submit(_issue_bytes(drv, key, ident, rng, "post-kill"))
+        assert ev.status == TxStatus.VALID
+        assert _counter("remote.failover.switches") - switches_before >= 1
+        assert client.address == follower_srv.address
+        # nothing acked was lost and nothing doubled
+        assert client.status("pre-kill").status == TxStatus.VALID
+        assert client.status("post-kill").status == TxStatus.VALID
+        assert follower_net.height() == 2
+    finally:
+        client.close()
+        follower_srv.stop()
+
+
+# ===================================================================
+# the kill-the-leader chaos soak (slow)
+# ===================================================================
+
+_REPL_CHILD = """
+import os, sys, threading
+sys.path.insert(0, sys.argv[4])
+from fabric_token_sdk_tpu.api.validator import RequestValidator
+from fabric_token_sdk_tpu.drivers.fabtoken import FabTokenDriver, FabTokenPublicParams
+from fabric_token_sdk_tpu.services.network.ledger import Network
+from fabric_token_sdk_tpu.services.network.remote import LedgerServer
+from fabric_token_sdk_tpu.services.network import replication
+
+wal_path, role, peer = sys.argv[1], sys.argv[2], sys.argv[3]
+validator = RequestValidator(FabTokenDriver(FabTokenPublicParams()))
+net = Network(validator, wal_path=wal_path)
+server = LedgerServer(network=net).start()
+if role == "follower":
+    replication.attach_follower(net)
+elif role == "leader":
+    host, _, port = peer.rpartition(":")
+    replication.attach_leader(net, [(host, int(port))])
+print("READY", server.address[1], flush=True)
+threading.Event().wait()
+"""
+
+
+def _spawn_repl_node(wal_path, role, peer="-"):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REPL_CHILD, str(wal_path), role, peer,
+         REPO_ROOT],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", FTS_BLOCK_BATCHED="0"),
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"repl child died rc={proc.returncode}:\n{proc.stderr.read()}"
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if ready:
+            line = proc.stdout.readline()
+            assert line.startswith("READY"), f"unexpected child output {line!r}"
+            return proc, int(line.split()[1])
+    proc.kill()
+    raise AssertionError("repl child never became ready")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_the_leader_chaos_soak(tmp_path):
+    """Acceptance: SIGKILL the leader subprocess mid-workload, promote
+    the follower, and prove the failover contract — zero acked-tx loss,
+    zero duplicate commits, bounded failover time, and fencing that
+    REFUSES the dead leader's epoch rather than merging it."""
+    pp, drv, key, ident, rng = _client_kit(seed=0xC0FFEE)
+    follower_wal = str(tmp_path / "follower.wal")
+    leader_wal = str(tmp_path / "leader.wal")
+    follower, fport = _spawn_repl_node(follower_wal, "follower")
+    leader, lport = _spawn_repl_node(
+        leader_wal, "leader", f"127.0.0.1:{fport}"
+    )
+    follower_addr = ("127.0.0.1", fport)
+    client = RemoteNetwork(
+        endpoints=[("127.0.0.1", lport), follower_addr],
+        timeout=5, retries=12, backoff_s=0.05,
+    )
+    acked = []
+    ack_times = []
+    errors = []
+    stop = threading.Event()
+
+    def workload():
+        k = 0
+        while not stop.is_set():
+            anchor = f"chaos-{k}"
+            k += 1
+            try:
+                ev = client.submit(
+                    _issue_bytes(drv, key, ident, rng, anchor)
+                )
+            except Exception as e:  # unacked: allowed to be lost
+                errors.append(e)
+                continue
+            if ev.status != TxStatus.VALID:
+                errors.append(AssertionError(f"rejected: {ev.message}"))
+                stop.set()
+                return
+            acked.append(anchor)
+            ack_times.append(time.monotonic())
+
+    t = threading.Thread(target=workload, daemon=True)
+    try:
+        t.start()
+        _wait(lambda: len(acked) >= 3, timeout=60,
+              what="pre-kill acknowledged traffic")
+        killed_at = time.monotonic()
+        os.kill(leader.pid, signal.SIGKILL)
+        leader.wait(timeout=30)
+        # explicit operator failover: promote the follower over the wire
+        promoter = RemoteNetwork(follower_addr, timeout=5, retries=5,
+                                 backoff_s=0.1)
+        epoch = promoter.promote()
+        assert epoch >= 1
+        pre_kill_acks = len(acked)
+        _wait(lambda: len(acked) >= pre_kill_acks + 3, timeout=90,
+              what="post-failover acknowledged traffic")
+        stop.set()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        # rejected txs are contract violations; transport errors during
+        # the failover window are expected and tolerated
+        fatal = [e for e in errors if isinstance(e, AssertionError)]
+        assert not fatal, fatal[0]
+        # bounded failover: the first post-kill ack landed within budget
+        post = [ts for ts in ack_times if ts > killed_at]
+        assert post, "no acked tx after the kill"
+        assert post[0] - killed_at < 60.0, (
+            f"failover took {post[0] - killed_at:.1f}s"
+        )
+        # zero acked-tx loss on the promoted node
+        for anchor in acked:
+            ev = promoter.status(anchor)
+            assert ev is not None and ev.status == TxStatus.VALID, anchor
+        # fencing, live: the dead leader's epoch-0 appends are REFUSED
+        resp = _raw_rpc(follower_addr, {
+            "op": "repl.ship", "epoch": 0, "record": b"zombie".hex(),
+        })
+        assert resp["ok"] is False
+        assert resp["error_class"] == "StaleEpoch"
+        snap = promoter.ops_metrics()
+        assert snap["counters"].get("repl.stale_rejected", 0) >= 1
+        promoter.close()
+    finally:
+        stop.set()
+        client.close()
+        for proc in (leader, follower):
+            if proc.poll() is None:
+                proc.kill()
+        follower.wait(timeout=30)
+    # zero duplicate commits: recover the follower's journal in-process
+    # and count every committed tx id across every block — this ALSO
+    # exercises recovery of a follower-written WAL
+    recovered = Network.recover(
+        RequestValidator(FabTokenDriver(pp)), follower_wal
+    )
+    seen = {}
+    for block in recovered._blocks:
+        for txid in block.txs:
+            seen[txid] = seen.get(txid, 0) + 1
+    dups = {txid: n for txid, n in seen.items() if n > 1}
+    assert not dups, f"tx ids committed twice across the failover: {dups}"
+    # and every acked tx is present in the recovered ledger too
+    for anchor in acked:
+        assert recovered.status(anchor).status == TxStatus.VALID, anchor
