@@ -274,9 +274,13 @@ class LedgerServer:
         except StaleEpoch as e:
             # fencing verdict for a zombie ex-leader: typed so its
             # shipper demotes itself instead of retrying (already counted
-            # under `repl.stale_rejected` at the fence)
+            # under `repl.stale_rejected` at the fence). The fencer's
+            # ACTUAL epoch rides along so the zombie adopts it exactly —
+            # a guessed demotion epoch could later collide with the real
+            # leader's.
             return {"ok": False, "error": str(e),
-                    "error_class": "StaleEpoch"}
+                    "error_class": "StaleEpoch",
+                    "epoch": getattr(e, "epoch", 0)}
         except Exception as e:  # defensive: never kill the server loop —
             # but never mask the failure either: log the traceback
             # server-side and hand the client the typed exception

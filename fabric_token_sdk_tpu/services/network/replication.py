@@ -82,7 +82,16 @@ class NotLeader(ReplicationError):
 
 
 class StaleEpoch(ReplicationError):
-    """A fenced-off message from a lower epoch (zombie ex-leader)."""
+    """A fenced-off message from a stale epoch (zombie ex-leader).
+
+    Carries the rejecting node's epoch so the zombie can demote to the
+    fencer's ACTUAL epoch — guessing (e.g. `own epoch + 1`) could leave
+    a later re-promotion at an epoch equal to the real leader's, and two
+    leaders must never share an epoch."""
+
+    def __init__(self, message: str, epoch: int = 0):
+        super().__init__(message)
+        self.epoch = epoch
 
 
 # ------------------------------------------------------------ epoch file
@@ -206,16 +215,21 @@ class ReplicaState:
 
     def _fence(self, msg_epoch: int, op: str) -> None:
         """Reject lower epochs (typed `StaleEpoch`), adopt higher ones —
-        adopting demotes a leader (two leaders cannot share an epoch:
-        promotion always bumps)."""
+        adopting demotes a leader. A LEADER also rejects its own epoch:
+        promotion always bumps, so an equal-epoch `repl.*` frame arriving
+        at a leader can only mean a second leader (split brain) — refuse
+        it rather than fork-merge."""
         with self._lock:
-            if msg_epoch < self.epoch:
+            if msg_epoch < self.epoch or (
+                msg_epoch == self.epoch and self.role == "leader"
+            ):
                 mx.counter("repl.stale_rejected").inc()
                 mx.flight("repl.fenced", op=op, msg_epoch=msg_epoch,
                           epoch=self.epoch)
                 raise StaleEpoch(
                     f"{op} from epoch {msg_epoch} rejected: this node is "
-                    f"fenced at epoch {self.epoch}"
+                    f"a {self.role} fenced at epoch {self.epoch}",
+                    epoch=self.epoch,
                 )
         if msg_epoch > self.epoch:
             self.demote(msg_epoch, f"{op} at higher epoch")
@@ -324,10 +338,13 @@ class _FollowerLink:
         self.address = (str(address[0]), int(address[1]))
         self.ship_timeout_s = ship_timeout_s
         self.heartbeat_s = heartbeat_s
-        self.link_state = "connecting"
         self.follower_height: Optional[int] = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, queue_max))
+        # guards link_state AND follower_height: the commit path reads
+        # both (ship's wait loop) while the link thread mutates them, so
+        # every transition notifies waiters through this one condition
         self._ack = threading.Condition()
+        self.link_state = "connecting"
         self._stop = threading.Event()
         self._dropping = False  # throttles the drop flight event
         self._breaker = resilience.CircuitBreaker(
@@ -343,8 +360,9 @@ class _FollowerLink:
         """Non-blocking: a full queue (slow follower) DROPS the record
         loudly — the next reconnect re-syncs from the journal, so a drop
         costs catch-up work, never correctness."""
-        if self.link_state in ("stopped", "fenced"):
-            return False
+        with self._ack:
+            if self.link_state in ("stopped", "fenced"):
+                return False
         try:
             self._queue.put_nowait((height, record))
             return True
@@ -356,17 +374,30 @@ class _FollowerLink:
                           height=height)
             return False
 
-    def wait_acked(self, height: int, deadline: float) -> bool:
+    def wait_acked(self, height: int, deadline: float) -> str:
         """Bounded wait for the follower's ack watermark to reach
-        `height`. Returns False at the deadline — the caller counts it
-        and moves on (degrade-only)."""
+        `height` — the follower's POST-apply height, i.e. `block index
+        + 1` for the record just shipped. Returns `"acked"`,
+        `"timeout"` (deadline expired on a streaming link — the caller
+        counts it and moves on, degrade-only), or `"unsynced"` (the
+        link is not streaming — connecting, syncing, breaker-open,
+        stopped, or fenced — so this record rides the journal re-sync
+        instead of the queue; counted by the caller so degraded
+        shipping is always visible)."""
         with self._ack:
-            while (self.follower_height or -1) < height:
+            while True:
+                acked = (
+                    -1 if self.follower_height is None
+                    else self.follower_height
+                )
+                if acked >= height:
+                    return "acked"
+                if self.link_state != "streaming":
+                    return "unsynced"
                 remaining = deadline - time.monotonic()
-                if remaining <= 0 or self.link_state in ("stopped", "fenced"):
-                    return False
+                if remaining <= 0:
+                    return "timeout"
                 self._ack.wait(timeout=min(remaining, 0.05))
-        return True
 
     def _addr_str(self) -> str:
         return f"{self.address[0]}:{self.address[1]}"
@@ -374,6 +405,11 @@ class _FollowerLink:
     def _set_follower_height(self, height: int) -> None:
         with self._ack:
             self.follower_height = height
+            self._ack.notify_all()
+
+    def _set_link_state(self, state: str) -> None:
+        with self._ack:
+            self.link_state = state
             self._ack.notify_all()
 
     # ---------------------------------------------- link thread
@@ -397,12 +433,12 @@ class _FollowerLink:
         backoff = 0.05
         while not self._stop.is_set():
             if not self._breaker.allow():
-                self.link_state = "breaker_open"
+                self._set_link_state("breaker_open")
                 self._stop.wait(0.2)
                 continue
             sock = None
             try:
-                self.link_state = "connecting"
+                self._set_link_state("connecting")
                 sock = socket.create_connection(
                     self.address, timeout=self.ship_timeout_s
                 )
@@ -410,7 +446,7 @@ class _FollowerLink:
                 self._catch_up(sock)
                 self._breaker.record_success()
                 backoff = 0.05
-                self.link_state = "streaming"
+                self._set_link_state("streaming")
                 self._dropping = False
                 self._stream(sock)
             except _LinkStopped:
@@ -420,7 +456,7 @@ class _FollowerLink:
             except Exception as e:
                 self._breaker.record_failure()
                 mx.counter("repl.link.errors").inc()
-                self.link_state = "reconnecting"
+                self._set_link_state("reconnecting")
                 logger.warning(
                     "repl: link to %s failed (%s: %s); reconnecting",
                     self._addr_str(), type(e).__name__, e,
@@ -456,13 +492,22 @@ class _FollowerLink:
                 "repl: follower %s is stopping; link demoted cleanly",
                 self._addr_str(),
             )
-            self.link_state = "stopped"
+            self._set_link_state("stopped")
             raise _LinkStopped()
         if klass == "StaleEpoch":
             # WE are the zombie: a promoted node fenced us off. Demote
-            # the whole leader — its epoch is history.
-            self.link_state = "fenced"
-            self.state.demote(self.state.epoch + 1, "fenced by follower")
+            # the whole leader to the fencer's ACTUAL epoch (it rides
+            # the typed answer) — never a guessed `epoch + 1`, which a
+            # later re-promotion could land EQUAL to the real leader's
+            # epoch (and equal-epoch leaders would merge each other's
+            # frames). `epoch + 1` survives only as the fallback for a
+            # peer that omits the field.
+            self._set_link_state("fenced")
+            fencer_epoch = int(resp.get("epoch") or 0)
+            self.state.demote(
+                fencer_epoch if fencer_epoch else self.state.epoch + 1,
+                "fenced by follower",
+            )
             logger.warning(
                 "repl: follower %s fenced this leader off (%s)",
                 self._addr_str(), resp.get("error"),
@@ -482,10 +527,10 @@ class _FollowerLink:
         is idempotent, and a gap is impossible."""
         from ...crypto.serialization import loads
 
-        self.link_state = "syncing"
+        self._set_link_state("syncing")
         st = self._rpc(sock, {"op": "repl.state"})
         if int(st.get("epoch", 0)) > self.state.epoch:
-            self.link_state = "fenced"
+            self._set_link_state("fenced")
             self.state.demote(int(st["epoch"]), "follower at higher epoch")
             raise _LinkStopped()
         follower_h = int(st.get("height", 0))
@@ -583,19 +628,32 @@ class Shipper:
 
     def ship(self, height: int, record: bytes) -> None:
         """Commit-path entry: enqueue to every live link, then wait —
-        bounded by `ship_timeout_s` — for the STREAMING links to ack.
+        bounded by `ship_timeout_s` — for the streaming links to ack.
         A healthy loopback follower acks in well under a millisecond, so
         an acknowledged tx is replicated before its submitter resolves;
-        a sick one times out, is counted, and the commit proceeds."""
+        a sick one times out, is counted, and the commit proceeds.
+
+        `height` is the record's block INDEX (the leader ships before
+        its own merge), so the ack target is `height + 1` — the
+        follower's post-apply height. Waiting for `height` itself would
+        be satisfied by a follower merely caught up through the
+        PREVIOUS record, i.e. every commit would only confirm its
+        predecessor's replication. Links that are not streaming
+        (connecting/syncing/breaker-open/stopped/fenced — including one
+        that flips mid-wait) are counted `repl.ship.unsynced`, never
+        waited on: their records ride the journal re-sync, and degraded
+        shipping stays visible."""
         t0 = time.monotonic()
         for link in self._links:
             link.enqueue(height, record)
         deadline = t0 + self.ship_timeout_s
+        target = height + 1
         for link in self._links:
-            if link.link_state != "streaming":
-                continue
-            if not link.wait_acked(height, deadline):
+            verdict = link.wait_acked(target, deadline)
+            if verdict == "timeout":
                 mx.counter("repl.ship.ack_timeouts").inc()
+            elif verdict == "unsynced":
+                mx.counter("repl.ship.unsynced").inc()
         mx.histogram("repl.ship.wait.seconds").observe(
             time.monotonic() - t0
         )
@@ -604,10 +662,12 @@ class Shipper:
         leader_h = self.state.network.height()
         rows = []
         for link in self._links:
-            fh = link.follower_height
+            with link._ack:  # consistent (state, height) snapshot
+                fh = link.follower_height
+                state = link.link_state
             rows.append({
                 "addr": link._addr_str(),
-                "state": link.link_state,
+                "state": state,
                 "height": fh,
                 "lag": (leader_h - fh) if fh is not None else None,
             })
@@ -663,8 +723,17 @@ def attach_follower(network, epoch_path: Optional[str] = None,
     `FTS_REPL=0`."""
     if not _enabled():
         return None
-    state = ReplicaState(network, "follower",
-                         epoch_path=_epoch_path(network, epoch_path))
+    resolved = _epoch_path(network, epoch_path)
+    if resolved is None:
+        # same refusal as attach_leader: without a durable epoch file a
+        # restarted follower comes back at epoch 0, so fencing would not
+        # survive exactly the crash it exists for
+        raise ReplicationError(
+            "replication follower needs a journaled network (wal_path=...)"
+            " or an explicit epoch_path: the fencing epoch must survive a"
+            " restart"
+        )
+    state = ReplicaState(network, "follower", epoch_path=resolved)
     network.repl = state
     if auto_promote is None:
         auto_promote = os.environ.get("FTS_REPL_AUTO_PROMOTE", "0") == "1"

@@ -78,6 +78,10 @@ class WriteAheadLog:
         )
         self.poisoned = False  # set when the on-disk state is unknowable
         self._lock = threading.Lock()
+        # bumped on every truncation (`_reopen`): record boundaries
+        # before and after a truncate are unrelated, so a replay scan
+        # started under an older generation must never act on the file
+        self._generation = 0
         self._fh = open(self.path, "ab")
         if self.sync:
             fsync_dir(self.path)  # the journal's dir entry must survive too
@@ -138,6 +142,7 @@ class WriteAheadLog:
                 pass
 
     def _reopen(self, size: int) -> None:
+        self._generation += 1
         self._fh.close()
         os.truncate(self.path, size)
         self._fh = open(self.path, "ab")
@@ -159,13 +164,18 @@ class WriteAheadLog:
         Torn-tail semantics match `replay()`: the first bad frame within
         the scanned span — short header, short payload, CRC mismatch —
         ends the stream, and the file is truncated back to the last good
-        boundary after re-verifying under the lock that no complete
-        record landed there in the meantime (so a concurrent append can
-        never be destroyed by a stale torn-tail verdict).
+        boundary after re-verifying under the lock that (a) the journal
+        has not been truncated/compacted since this scan began (the
+        generation guard — post-compaction boundaries are unrelated to
+        this scan's offsets, so a stale verdict must be a no-op, never a
+        mid-record truncation of live fsync'd records) and (b) no
+        complete record landed at the boundary in the meantime (so a
+        concurrent append can never be destroyed either).
         """
         with self._lock:
             self._fh.flush()
             size = os.path.getsize(self.path)
+            generation = self._generation
         good = from_offset
         yielded = 0
         with open(self.path, "rb") as fh:
@@ -185,13 +195,19 @@ class WriteAheadLog:
                 yielded += 1
                 yield good, payload
         if good < size:
-            self._truncate_torn(good, yielded)
+            self._truncate_torn(good, yielded, generation)
 
-    def _truncate_torn(self, good: int, records: int) -> None:
+    def _truncate_torn(self, good: int, records: int,
+                       generation: int) -> None:
         """Truncate a torn tail back to the record boundary `good`,
-        unless a complete record has landed there since the scan (a
+        unless the journal was truncated/compacted since the scan began
+        (`generation` mismatch: `good` is an offset into a file that no
+        longer exists — acting on it would cut a LIVE record in half) or
+        a complete record has landed at the boundary in the meantime (a
         concurrent append on a live journal must never be destroyed)."""
         with self._lock:
+            if self._generation != generation:
+                return  # stale scan: boundaries have moved under it
             self._fh.flush()
             size = os.path.getsize(self.path)
             if size <= good:

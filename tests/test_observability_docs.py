@@ -322,6 +322,7 @@ def test_replication_names_pinned_both_ways():
         "repl.shipped.records",
         "repl.ship.dropped",
         "repl.ship.ack_timeouts",
+        "repl.ship.unsynced",
         "repl.applied.records",
         "repl.apply.skipped",
         "repl.bootstraps",

@@ -134,6 +134,27 @@ def test_replay_iter_stream_offsets_and_resume(tmp_path):
     wal.close()
 
 
+def test_replay_iter_stale_scan_never_truncates_live_journal(tmp_path):
+    """A replay scan that started BEFORE a compaction must never act on
+    the regrown journal: its offsets point into a file that no longer
+    exists, so a CRC mismatch mid-record there is a stale verdict, not a
+    torn tail — truncating would cut live fsync'd records in half."""
+    wal = WriteAheadLog(tmp_path / "t.wal")
+    wal.append(b"one")
+    wal.append(b"two-a-longer-record")
+    stale = wal.replay_iter()
+    next(stale)  # scan begins: size + generation captured pre-compaction
+    # leader compacts and regrows: boundaries shift under the stale scan
+    wal.reset()
+    wal.append(b"a")
+    wal.append(b"b" * 64)
+    torn_before = _counter("wal.torn_tails")
+    list(stale)  # drains over garbage at old offsets; must be a no-op
+    assert _counter("wal.torn_tails") == torn_before
+    assert wal.replay() == [b"a", b"b" * 64]
+    wal.close()
+
+
 def test_replay_iter_truncates_torn_tail(tmp_path):
     path = tmp_path / "t.wal"
     wal = WriteAheadLog(path)
@@ -192,6 +213,16 @@ def test_attach_is_degrade_only(tmp_path, monkeypatch):
     plain = Network(RequestValidator(FabTokenDriver(pp)))
     with pytest.raises(replication.ReplicationError):
         replication.attach_leader(plain, [("127.0.0.1", 1)])
+    # a follower NEEDS a durable epoch home too: without one a restart
+    # comes back at epoch 0 and fencing does not survive the crash
+    with pytest.raises(replication.ReplicationError):
+        replication.attach_follower(plain)
+    # ... unless an explicit epoch_path supplies the durability
+    state = replication.attach_follower(
+        plain, epoch_path=str(tmp_path / "plain.epoch")
+    )
+    assert state is not None
+    state.close()
 
 
 # ===================================================================
@@ -265,6 +296,34 @@ def test_ship_catchup_health_and_promotion(tmp_path):
         follower_srv.stop()
 
 
+def test_acked_commit_is_on_follower_before_submit_returns(tmp_path):
+    """The ack watermark is the follower's POST-apply height: a commit's
+    bounded ship wait must cover the record just committed, not merely
+    confirm the PREVIOUS record's replication — otherwise the newest
+    acked tx is always the unreplicated one when the leader dies."""
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    try:
+        replication.attach_follower(follower_net)
+        replication.attach_leader(leader_net, [follower_srv.address])
+        _wait(lambda: leader_net.repl.shipper.link_states()[0]["state"]
+              == "streaming", what="link streaming")
+        for i in range(3):
+            ev = leader_net.submit(
+                _issue_bytes(drv, key, ident, rng, f"sync-{i}")
+            )
+            assert ev.status == TxStatus.VALID
+            # NO wait: by the time the submitter holds the ack, the
+            # streaming follower must already hold the block
+            assert follower_net.height() == leader_net.height()
+            assert follower_net.status(f"sync-{i}").status == TxStatus.VALID
+    finally:
+        follower_srv.stop()
+        leader_net.repl.close()
+
+
 def test_snapshot_bootstrap_for_compacted_leader(tmp_path):
     pp, drv, key, ident, rng = _client_kit()
     # snapshot_every=1: every commit compacts, so the journal never
@@ -309,7 +368,16 @@ def test_fencing_rejects_stale_frames_and_demotes_zombies(tmp_path):
         })
         assert resp["ok"] is False
         assert resp["error_class"] == "StaleEpoch"
+        assert resp["epoch"] == 1  # the fencer's ACTUAL epoch rides along
         assert _counter("repl.stale_rejected") - stale_before == 1
+        # a LEADER also refuses its own epoch: promotion always bumps,
+        # so an equal-epoch frame can only be a second leader (split
+        # brain), never a colleague
+        resp = _raw_rpc(node_srv.address, {
+            "op": "repl.ship", "epoch": 1, "record": b"junk".hex(),
+        })
+        assert resp["ok"] is False
+        assert resp["error_class"] == "StaleEpoch"
         height_before = node_net.height()
         # a full zombie LEADER (epoch 0, divergent journal) reattaching:
         # the repl.state handshake teaches it the higher epoch and it
@@ -331,6 +399,43 @@ def test_fencing_rejects_stale_frames_and_demotes_zombies(tmp_path):
         zombie_state.close()
     finally:
         node_srv.stop()
+
+
+def test_fenced_leader_adopts_fencers_actual_epoch(tmp_path):
+    """A fenced zombie demotes to the fencer's ACTUAL epoch (it rides
+    the typed `StaleEpoch` answer), not a guessed `epoch + 1` — the
+    guess would let a later re-promotion land EQUAL to the real leader's
+    epoch, and equal-epoch leaders would merge each other's frames."""
+    pp, drv, key, ident, rng = _client_kit()
+    leader_net = _fab_net(tmp_path / "leader.wal", pp)
+    follower_net = _fab_net(tmp_path / "follower.wal", pp)
+    follower_srv = LedgerServer(network=follower_net).start()
+    try:
+        replication.attach_follower(follower_net)
+        # huge heartbeat: the only traffic after streaming is the ship
+        # below, so the fence verdict deterministically rides IT
+        state = replication.attach_leader(
+            leader_net, [follower_srv.address], heartbeat_s=60.0
+        )
+        _wait(lambda: state.shipper.link_states()[0]["state"]
+              == "streaming", what="link streaming")
+        # walk the follower to a HIGH epoch (promote bumps, demote at an
+        # equal epoch only flips the role back), then lead at epoch 5
+        fstate = follower_net.repl
+        for _ in range(4):
+            fstate.promote(reason="cycle")
+            fstate.demote(0, "cycle")
+        fstate.promote(reason="final")
+        assert fstate.epoch == 5
+        ev = leader_net.submit(_issue_bytes(drv, key, ident, rng, "fence"))
+        assert ev.status == TxStatus.VALID  # degrade-only: commit stands
+        _wait(lambda: state.role == "follower", what="zombie demotion")
+        assert state.epoch == 5, (
+            f"demoted to guessed epoch {state.epoch}, not the fencer's"
+        )
+    finally:
+        follower_srv.stop()
+        state.close()
 
 
 def test_auto_promote_lease_watchdog(tmp_path, monkeypatch):
@@ -401,6 +506,7 @@ def test_dead_follower_never_stalls_commit(tmp_path):
     )
     try:
         dropped_before = _counter("repl.ship.dropped")
+        unsynced_before = _counter("repl.ship.unsynced")
         t0 = time.monotonic()
         for i in range(4):
             ev = leader_net.submit(
@@ -411,6 +517,9 @@ def test_dead_follower_never_stalls_commit(tmp_path):
         assert wall < 5.0, f"commits stalled {wall:.1f}s behind a dead link"
         # the bounded queue overflowed LOUDLY instead of growing
         assert _counter("repl.ship.dropped") - dropped_before >= 2
+        # ... and every skipped ack wait on the never-streaming link is
+        # visible too, not silently uncounted
+        assert _counter("repl.ship.unsynced") - unsynced_before >= 4
         assert state.shipper.link_states()[0]["state"] != "streaming"
     finally:
         state.close()
